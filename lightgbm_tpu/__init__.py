@@ -1,0 +1,21 @@
+"""lightgbm_tpu: TPU-native gradient boosting framework (JAX/XLA/Pallas).
+
+A ground-up redesign of LightGBM's capabilities (reference:
+SNSerHello/LightGBM, mounted at /root/reference) for TPU hardware:
+histogram GBDT with device-resident binned data, fully-jitted tree growth,
+and data-/feature-/voting-parallel training over `jax.sharding` meshes.
+"""
+
+__version__ = "0.1.0"
+
+from .config import Config
+from .utils.log import LightGBMError, register_logger
+
+try:  # user-facing API (available once all layers are built)
+    from .basic import Booster, Dataset
+    from .engine import cv, train
+except ImportError:  # pragma: no cover - during partial builds only
+    pass
+
+__all__ = ["Dataset", "Booster", "train", "cv", "Config", "LightGBMError",
+           "register_logger", "__version__"]

@@ -1,0 +1,385 @@
+"""Configuration system: typed parameter registry with alias resolution.
+
+TPU-native re-design of the reference config layer
+(reference: include/LightGBM/config.h:34 `struct Config`,
+src/io/config_auto.cpp:10 alias table, src/io/config.cpp:261 CheckParamConflict).
+
+Instead of generated C++ getters, parameters are declared once in a registry
+(`_PARAMS`) carrying type, default, aliases and constraints; `Config` is a
+plain dataclass-like object resolved from a user dict / config file / CLI
+key=value pairs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = ["Config", "ParamSpec", "param_dict_to_config", "PARAM_ALIASES"]
+
+
+@dataclasses.dataclass
+class ParamSpec:
+    name: str
+    type: type
+    default: Any
+    aliases: Tuple[str, ...] = ()
+    check: Optional[Callable[[Any], bool]] = None
+    desc: str = ""
+
+
+def _p(name, type_, default, aliases=(), check=None, desc=""):
+    return ParamSpec(name, type_, default, tuple(aliases), check, desc)
+
+
+# Registry mirrors reference include/LightGBM/config.h. Grouped as in
+# docs/Parameters.rst: core, learning control, IO, objective, metric, network.
+_PARAMS: List[ParamSpec] = [
+    # ---- Core parameters (config.h:96-226) ----
+    _p("config", str, "", ("config_file",)),
+    _p("task", str, "train",
+       ("task_type",), lambda v: v in ("train", "predict", "convert_model",
+                                       "refit", "save_binary")),
+    _p("objective", str, "regression",
+       ("objective_type", "app", "application", "loss")),
+    _p("boosting", str, "gbdt",
+       ("boosting_type", "boost"),
+       lambda v: v in ("gbdt", "rf", "dart", "goss")),
+    _p("data", str, "", ("train", "train_data", "train_data_file", "data_filename")),
+    _p("valid", str, "", ("test", "valid_data", "valid_data_file", "test_data",
+                          "test_data_file", "valid_filenames")),
+    _p("num_iterations", int, 100,
+       ("num_iteration", "n_iter", "num_tree", "num_trees", "num_round",
+        "num_rounds", "nrounds", "num_boost_round", "n_estimators",
+        "max_iter")),
+    _p("learning_rate", float, 0.1, ("shrinkage_rate", "eta"),
+       lambda v: v > 0.0),
+    _p("num_leaves", int, 31, ("num_leaf", "max_leaves", "max_leaf",
+                               "max_leaf_nodes"),
+       lambda v: 1 < v <= 131072),
+    _p("tree_learner", str, "serial",
+       ("tree", "tree_type", "tree_learner_type"),
+       lambda v: v in ("serial", "feature", "data", "voting")),
+    _p("num_threads", int, 0, ("num_thread", "nthread", "nthreads", "n_jobs")),
+    _p("device_type", str, "tpu", ("device",),
+       lambda v: v in ("cpu", "gpu", "cuda", "cuda_exp", "tpu")),
+    _p("seed", int, 0, ("random_seed", "random_state")),
+    _p("deterministic", bool, False),
+    # ---- Learning control (config.h:229-680) ----
+    _p("force_col_wise", bool, False),
+    _p("force_row_wise", bool, False),
+    _p("histogram_pool_size", float, -1.0, ("hist_pool_size",)),
+    _p("max_depth", int, -1),
+    _p("min_data_in_leaf", int, 20,
+       ("min_data_per_leaf", "min_data", "min_child_samples", "min_samples_leaf"),
+       lambda v: v >= 0),
+    _p("min_sum_hessian_in_leaf", float, 1e-3,
+       ("min_sum_hessian_per_leaf", "min_sum_hessian", "min_hessian",
+        "min_child_weight"),
+       lambda v: v >= 0.0),
+    _p("bagging_fraction", float, 1.0,
+       ("sub_row", "subsample", "bagging"),
+       lambda v: 0.0 < v <= 1.0),
+    _p("pos_bagging_fraction", float, 1.0,
+       ("pos_sub_row", "pos_subsample", "pos_bagging"),
+       lambda v: 0.0 < v <= 1.0),
+    _p("neg_bagging_fraction", float, 1.0,
+       ("neg_sub_row", "neg_subsample", "neg_bagging"),
+       lambda v: 0.0 < v <= 1.0),
+    _p("bagging_freq", int, 0, ("subsample_freq",)),
+    _p("bagging_seed", int, 3, ("bagging_fraction_seed",)),
+    _p("feature_fraction", float, 1.0,
+       ("sub_feature", "colsample_bytree"), lambda v: 0.0 < v <= 1.0),
+    _p("feature_fraction_bynode", float, 1.0,
+       ("sub_feature_bynode", "colsample_bynode"), lambda v: 0.0 < v <= 1.0),
+    _p("feature_fraction_seed", int, 2),
+    _p("extra_trees", bool, False, ("extra_tree",)),
+    _p("extra_seed", int, 6),
+    _p("early_stopping_round", int, 0,
+       ("early_stopping_rounds", "early_stopping", "n_iter_no_change")),
+    _p("first_metric_only", bool, False),
+    _p("max_delta_step", float, 0.0, ("max_tree_output", "max_leaf_output")),
+    _p("lambda_l1", float, 0.0, ("reg_alpha", "l1_regularization"),
+       lambda v: v >= 0.0),
+    _p("lambda_l2", float, 0.0, ("reg_lambda", "lambda", "l2_regularization"),
+       lambda v: v >= 0.0),
+    _p("linear_lambda", float, 0.0, (), lambda v: v >= 0.0),
+    _p("min_gain_to_split", float, 0.0, ("min_split_gain",),
+       lambda v: v >= 0.0),
+    _p("drop_rate", float, 0.1, ("rate_drop",), lambda v: 0.0 <= v <= 1.0),
+    _p("max_drop", int, 50),
+    _p("skip_drop", float, 0.5, (), lambda v: 0.0 <= v <= 1.0),
+    _p("xgboost_dart_mode", bool, False),
+    _p("uniform_drop", bool, False),
+    _p("drop_seed", int, 4),
+    _p("top_rate", float, 0.2, (), lambda v: 0.0 <= v <= 1.0),
+    _p("other_rate", float, 0.1, (), lambda v: 0.0 <= v <= 1.0),
+    _p("min_data_per_group", int, 100, (), lambda v: v > 0),
+    _p("max_cat_threshold", int, 32, (), lambda v: v > 0),
+    _p("cat_l2", float, 10.0, (), lambda v: v >= 0.0),
+    _p("cat_smooth", float, 10.0, (), lambda v: v >= 0.0),
+    _p("max_cat_to_onehot", int, 4, (), lambda v: v > 0),
+    _p("top_k", int, 20, ("topk",), lambda v: v > 0),
+    _p("monotone_constraints", list, None, ("mc", "monotone_constraint",
+                                            "monotonic_cst")),
+    _p("monotone_constraints_method", str, "basic",
+       ("monotone_constraining_method", "mc_method"),
+       lambda v: v in ("basic", "intermediate", "advanced")),
+    _p("monotone_penalty", float, 0.0, ("monotone_splits_penalty",
+                                        "ms_penalty", "mc_penalty"),
+       lambda v: v >= 0.0),
+    _p("feature_contri", list, None, ("feature_contrib", "fc", "fp",
+                                      "feature_penalty")),
+    _p("forcedsplits_filename", str, "", ("fs", "forced_splits_filename",
+                                          "forced_splits_file", "forced_splits")),
+    _p("refit_decay_rate", float, 0.9, (), lambda v: 0.0 <= v <= 1.0),
+    _p("cegb_tradeoff", float, 1.0, (), lambda v: v >= 0.0),
+    _p("cegb_penalty_split", float, 0.0, (), lambda v: v >= 0.0),
+    _p("cegb_penalty_feature_lazy", list, None),
+    _p("cegb_penalty_feature_coupled", list, None),
+    _p("path_smooth", float, 0.0, (), lambda v: v >= 0.0),
+    _p("interaction_constraints", list, None),
+    _p("verbosity", int, 1, ("verbose",)),
+    _p("input_model", str, "", ("model_input", "model_in")),
+    _p("output_model", str, "LightGBM_model.txt",
+       ("model_output", "model_out")),
+    _p("saved_feature_importance_type", int, 0),
+    _p("snapshot_freq", int, -1, ("save_period",)),
+    # ---- IO / dataset (config.h:683-940) ----
+    _p("max_bin", int, 255, ("max_bins",), lambda v: v > 1),
+    _p("max_bin_by_feature", list, None),
+    _p("min_data_in_bin", int, 3, (), lambda v: v > 0),
+    _p("bin_construct_sample_cnt", int, 200000, ("subsample_for_bin",),
+       lambda v: v > 0),
+    _p("data_random_seed", int, 1, ("data_seed",)),
+    _p("is_enable_sparse", bool, True, ("is_sparse", "enable_sparse", "sparse")),
+    _p("enable_bundle", bool, True, ("is_enable_bundle", "bundle")),
+    _p("use_missing", bool, True),
+    _p("zero_as_missing", bool, False),
+    _p("feature_pre_filter", bool, True),
+    _p("pre_partition", bool, False, ("is_pre_partition",)),
+    _p("two_round", bool, False, ("two_round_loading", "use_two_round_loading")),
+    _p("header", bool, False, ("has_header",)),
+    _p("label_column", str, "", ("label",)),
+    _p("weight_column", str, "", ("weight",)),
+    _p("group_column", str, "", ("group", "group_id", "query_column", "query",
+                                 "query_id")),
+    _p("ignore_column", str, "", ("ignore_feature", "blacklist")),
+    _p("categorical_feature", str, "", ("cat_feature", "categorical_column",
+                                        "cat_column")),
+    _p("forcedbins_filename", str, ""),
+    _p("save_binary", bool, False, ("is_save_binary", "is_save_binary_file")),
+    _p("precise_float_parser", bool, False),
+    # ---- Predict (config.h:943-1003) ----
+    _p("start_iteration_predict", int, 0),
+    _p("num_iteration_predict", int, -1),
+    _p("predict_raw_score", bool, False, ("is_predict_raw_score",
+                                          "predict_rawscore", "raw_score")),
+    _p("predict_leaf_index", bool, False, ("is_predict_leaf_index",
+                                           "leaf_index")),
+    _p("predict_contrib", bool, False, ("is_predict_contrib", "contrib")),
+    _p("predict_disable_shape_check", bool, False),
+    _p("pred_early_stop", bool, False),
+    _p("pred_early_stop_freq", int, 10),
+    _p("pred_early_stop_margin", float, 10.0),
+    _p("output_result", str, "LightGBM_predict_result.txt",
+       ("predict_result", "prediction_result", "predict_name",
+        "prediction_name", "pred_name", "name_pred")),
+    # ---- Convert (config.h:1006-1020) ----
+    _p("convert_model_language", str, ""),
+    _p("convert_model", str, "gbdt_prediction.cpp",
+       ("convert_model_file",)),
+    # ---- Objective (config.h:1023-1130) ----
+    _p("num_class", int, 1, ("num_classes",), lambda v: v > 0),
+    _p("is_unbalance", bool, False, ("unbalance", "unbalanced_sets")),
+    _p("scale_pos_weight", float, 1.0, (), lambda v: v > 0.0),
+    _p("sigmoid", float, 1.0, (), lambda v: v > 0.0),
+    _p("boost_from_average", bool, True),
+    _p("reg_sqrt", bool, False),
+    _p("alpha", float, 0.9, (), lambda v: v > 0.0),
+    _p("fair_c", float, 1.0, (), lambda v: v > 0.0),
+    _p("poisson_max_delta_step", float, 0.7, (), lambda v: v > 0.0),
+    _p("tweedie_variance_power", float, 1.5, (), lambda v: 1.0 <= v < 2.0),
+    _p("lambdarank_truncation_level", int, 30, (), lambda v: v > 0),
+    _p("lambdarank_norm", bool, True),
+    _p("label_gain", list, None),
+    _p("linear_tree", bool, False, ("linear_trees",)),
+    # ---- Metric (config.h:1133-1174) ----
+    _p("metric", str, "", ("metrics", "metric_types")),
+    _p("metric_freq", int, 1, ("output_freq",), lambda v: v > 0),
+    _p("is_provide_training_metric", bool, False,
+       ("training_metric", "is_training_metric", "train_metric")),
+    _p("eval_at", list, None, ("ndcg_eval_at", "ndcg_at", "map_eval_at",
+                               "map_at")),
+    _p("multi_error_top_k", int, 1, (), lambda v: v > 0),
+    _p("auc_mu_weights", list, None),
+    # ---- Network (config.h:1177-1210) ----
+    _p("num_machines", int, 1, ("num_machine",), lambda v: v > 0),
+    _p("local_listen_port", int, 12400, ("local_port", "port"),
+       lambda v: v > 0),
+    _p("time_out", int, 120, (), lambda v: v > 0),
+    _p("machine_list_filename", str, "", ("machine_list_file", "machine_list",
+                                          "mlist")),
+    _p("machines", str, "", ("workers", "nodes")),
+    # ---- TPU-specific (new; no reference analog) ----
+    _p("num_devices", int, 0, (),
+       desc="devices in the mesh; 0 = use all visible"),
+    _p("hist_dtype", str, "float32", (),
+       lambda v: v in ("float32", "bfloat16"),
+       "accumulation dtype for histograms"),
+    _p("growth_passes_per_tree", int, 0, (),
+       desc="max frontier passes per tree; 0 = auto from num_leaves/max_depth"),
+    _p("use_pallas", bool, True, (),
+       desc="use Pallas histogram kernel on TPU when applicable"),
+]
+
+_SPEC_BY_NAME: Dict[str, ParamSpec] = {p.name: p for p in _PARAMS}
+
+# alias -> canonical name (reference: src/io/config_auto.cpp:10 alias_table)
+PARAM_ALIASES: Dict[str, str] = {}
+for _spec in _PARAMS:
+    for _a in _spec.aliases:
+        PARAM_ALIASES[_a] = _spec.name
+
+
+def _coerce(spec: ParamSpec, value: Any) -> Any:
+    """Coerce a raw (possibly string) value to the spec's type."""
+    if value is None:
+        return None
+    if spec.type is bool:
+        if isinstance(value, str):
+            return value.lower() in ("true", "1", "yes", "+", "t", "on")
+        return bool(value)
+    if spec.type is int:
+        return int(float(value)) if isinstance(value, str) else int(value)
+    if spec.type is float:
+        return float(value)
+    if spec.type is list:
+        if isinstance(value, str):
+            if not value:
+                return None
+            parts = [v for v in value.replace(";", ",").split(",") if v != ""]
+            out = []
+            for x in parts:
+                try:
+                    out.append(int(x))
+                except ValueError:
+                    try:
+                        out.append(float(x))
+                    except ValueError:
+                        out.append(x)
+            return out
+        if isinstance(value, (list, tuple)):
+            return list(value)
+        return [value]
+    if spec.type is str:
+        return str(value)
+    return value
+
+
+class Config:
+    """Resolved parameter set. Attribute access for every registered param."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None):
+        for spec in _PARAMS:
+            setattr(self, spec.name, spec.default)
+        self.raw_params: Dict[str, Any] = {}
+        if params:
+            self.update(params)
+
+    def update(self, params: Dict[str, Any]) -> "Config":
+        canon: Dict[str, Any] = {}
+        for key, value in params.items():
+            name = PARAM_ALIASES.get(key, key)
+            if name in canon and canon[name] != value:
+                # first occurrence wins among aliases, like reference
+                # Config::SetMembersFromMap keeping canonical precedence
+                continue
+            canon[name] = value
+        for name, value in canon.items():
+            spec = _SPEC_BY_NAME.get(name)
+            if spec is None:
+                # unknown params are kept (custom objective extras etc.)
+                self.raw_params[name] = value
+                continue
+            coerced = _coerce(spec, value)
+            if spec.check is not None and coerced is not None \
+                    and not spec.check(coerced):
+                raise ValueError(
+                    f"Invalid value {value!r} for parameter {name!r}")
+            setattr(self, name, coerced)
+            self.raw_params[name] = value
+        self._resolve_conflicts()
+        return self
+
+    # reference: src/io/config.cpp:261 CheckParamConflict
+    def _resolve_conflicts(self) -> None:
+        if self.is_parallel and self.bagging_freq > 0 and \
+                self.bagging_fraction < 1.0 and self.tree_learner == "feature":
+            # feature-parallel shares all rows; bagging must be synchronized
+            pass
+        if self.boosting == "rf":
+            if self.bagging_freq <= 0 or self.bagging_fraction >= 1.0:
+                self.bagging_freq = max(self.bagging_freq, 1)
+                self.bagging_fraction = min(self.bagging_fraction, 0.9)
+        if self.boosting == "goss":
+            # GOSS replaces bagging
+            self.bagging_freq = 0
+            self.bagging_fraction = 1.0
+        if self.max_depth > 0:
+            # cap num_leaves by full tree at max_depth
+            full = 1 << min(self.max_depth, 30)
+            if self.num_leaves > full:
+                self.num_leaves = full
+        if self.is_parallel and self.monotone_constraints is not None and \
+                self.monotone_constraints_method == "intermediate":
+            self.monotone_constraints_method = "basic"
+        if self.linear_tree and self.boosting == "goss":
+            raise ValueError("linear_tree is not supported with goss boosting")
+
+    @property
+    def is_parallel(self) -> bool:
+        return self.tree_learner != "serial" or self.num_machines > 1
+
+    @property
+    def is_data_based_parallel(self) -> bool:
+        return self.tree_learner in ("data", "voting")
+
+    @property
+    def max_nodes(self) -> int:
+        return 2 * self.num_leaves - 1
+
+    def metric_list(self) -> List[str]:
+        if not self.metric:
+            return []
+        if isinstance(self.metric, (list, tuple)):
+            return list(self.metric)
+        return [m for m in str(self.metric).replace(";", ",").split(",") if m]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {p.name: getattr(self, p.name) for p in _PARAMS}
+
+    def __repr__(self) -> str:
+        mods = {k: v for k, v in self.to_dict().items()
+                if v != _SPEC_BY_NAME[k].default}
+        return f"Config({mods})"
+
+
+def param_dict_to_config(params: Optional[Dict[str, Any]]) -> Config:
+    return Config(params or {})
+
+
+def parse_config_file(path: str) -> Dict[str, str]:
+    """Parse `key=value` lines; '#' starts a comment.
+
+    Reference: Application ctor config-file parsing (application.cpp:50-83).
+    """
+    out: Dict[str, str] = {}
+    with open(path, "r") as fh:
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            key, value = line.split("=", 1)
+            out[key.strip()] = value.strip()
+    return out

@@ -1,0 +1,185 @@
+"""Binned dataset resident in device HBM + training metadata.
+
+Redesign of the reference data layer (include/LightGBM/dataset.h:355
+`Dataset`, dataset.h:45 `Metadata`, feature_group.h:25 `FeatureGroup`):
+
+- the reference stores column-oriented `Bin` objects (dense_bin.hpp:53) with
+  optional 4-bit packing and multi-value row-wise mirrors
+  (multi_val_dense_bin.hpp:20) chosen by runtime probing
+  (dataset.cpp:600-702). On TPU a single row-major `[num_data, num_features]`
+  uint8/uint16 matrix in HBM is the right layout: histogram build reads it
+  row-wise (the probe is unnecessary), and XLA tiles it.
+- trivial features (single bin) are dropped up-front like the reference's
+  feature_pre_filter (dataset_loader feature filtering); the used->original
+  index map is kept for model output.
+- EFB bundling (feature_group.h:25) is unnecessary for dense HBM storage:
+  bundling saved *column passes* in the CPU design; the TPU scatter reads
+  every (row, feature) cell exactly once either way. Sparse-input densify
+  happens at construction.
+
+`Metadata` carries label/weight/group/init_score and the query boundaries
+used by ranking objectives (reference src/io/metadata.cpp:577).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .binning import BinMapper, find_bin_mappers
+from .utils.log import Log
+
+__all__ = ["Metadata", "BinnedDataset"]
+
+
+class Metadata:
+    """Labels, weights, query boundaries, init scores (dataset.h:45)."""
+
+    def __init__(self, num_data: int,
+                 label: Optional[np.ndarray] = None,
+                 weight: Optional[np.ndarray] = None,
+                 group: Optional[np.ndarray] = None,
+                 init_score: Optional[np.ndarray] = None):
+        self.num_data = num_data
+        self.label = None if label is None else \
+            np.ascontiguousarray(label, dtype=np.float32).reshape(-1)
+        self.weight = None if weight is None else \
+            np.ascontiguousarray(weight, dtype=np.float32).reshape(-1)
+        self.init_score = None if init_score is None else \
+            np.ascontiguousarray(init_score, dtype=np.float64)
+        # group: either sizes per query or boundaries; store boundaries
+        self.query_boundaries: Optional[np.ndarray] = None
+        if group is not None:
+            group = np.asarray(group)
+            if len(group) and group[0] == 0 and np.all(np.diff(group) >= 0):
+                self.query_boundaries = group.astype(np.int64)
+            else:
+                self.query_boundaries = np.concatenate(
+                    [[0], np.cumsum(group)]).astype(np.int64)
+        self._validate()
+
+    def _validate(self) -> None:
+        if self.label is not None and len(self.label) != self.num_data:
+            Log.fatal("Length of label (%d) != num_data (%d)",
+                      len(self.label), self.num_data)
+        if self.weight is not None and len(self.weight) != self.num_data:
+            Log.fatal("Length of weight (%d) != num_data (%d)",
+                      len(self.weight), self.num_data)
+        if self.query_boundaries is not None and \
+                self.query_boundaries[-1] != self.num_data:
+            Log.fatal("Sum of query counts (%d) != num_data (%d)",
+                      int(self.query_boundaries[-1]), self.num_data)
+
+    @property
+    def num_queries(self) -> int:
+        if self.query_boundaries is None:
+            return 0
+        return len(self.query_boundaries) - 1
+
+    def query_ids(self) -> Optional[np.ndarray]:
+        """Per-row query id (for segment ops in ranking objectives)."""
+        if self.query_boundaries is None:
+            return None
+        sizes = np.diff(self.query_boundaries)
+        return np.repeat(np.arange(len(sizes), dtype=np.int32), sizes)
+
+
+class BinnedDataset:
+    """Quantized dataset: `[num_data, num_used_features]` bin matrix.
+
+    Reference Dataset (dataset.h:355) minus the feature-group machinery;
+    `construct histograms` lives in learner/histogram.py and takes the raw
+    arrays, keeping this class a pure data holder.
+    """
+
+    def __init__(self, bins: np.ndarray, mappers: List[BinMapper],
+                 used_features: np.ndarray, num_total_features: int,
+                 metadata: Metadata,
+                 feature_names: Optional[List[str]] = None):
+        assert bins.shape[1] == len(used_features)
+        self.bins = bins                      # [N, F_used] uint8/uint16
+        self.mappers = mappers                # per USED feature
+        self.used_features = used_features    # used idx -> original idx
+        self.num_total_features = num_total_features
+        self.metadata = metadata
+        self.feature_names = feature_names or [
+            f"Column_{i}" for i in range(num_total_features)]
+        # per-used-feature bin counts and flat offsets
+        self.num_bins = np.array([m.num_bin for m in mappers], dtype=np.int32)
+        self.feature_offsets = np.concatenate(
+            [[0], np.cumsum(self.num_bins)]).astype(np.int32)
+        self.total_bins = int(self.feature_offsets[-1])
+        self.is_categorical = np.array(
+            [m.is_categorical for m in mappers], dtype=bool)
+        self.missing_types = np.array(
+            [m.missing_type for m in mappers], dtype=np.int32)
+        self.default_bins = np.array(
+            [m.default_bin for m in mappers], dtype=np.int32)
+
+    # ---- construction -------------------------------------------------
+    @staticmethod
+    def from_raw(X: np.ndarray, metadata: Metadata, max_bin: int = 255,
+                 min_data_in_bin: int = 3, sample_cnt: int = 200000,
+                 use_missing: bool = True, zero_as_missing: bool = False,
+                 categorical_features: Optional[Sequence[int]] = None,
+                 seed: int = 1, feature_names: Optional[List[str]] = None,
+                 mappers: Optional[List[BinMapper]] = None,
+                 feature_pre_filter: bool = True) -> "BinnedDataset":
+        """Quantize raw features. If `mappers` given, reuse them (aligned
+        valid set — reference LoadFromFileAlignWithOtherDataset,
+        dataset_loader.cpp:299)."""
+        X = np.asarray(X)
+        if X.ndim != 2:
+            raise ValueError("X must be 2-dimensional")
+        num_data, num_total = X.shape
+        if mappers is None:
+            all_mappers = find_bin_mappers(
+                X, max_bin=max_bin, min_data_in_bin=min_data_in_bin,
+                sample_cnt=sample_cnt, use_missing=use_missing,
+                zero_as_missing=zero_as_missing,
+                categorical_features=categorical_features, seed=seed)
+        else:
+            if len(mappers) != num_total:
+                raise ValueError(
+                    f"got {len(mappers)} bin mappers for {num_total} features")
+            all_mappers = mappers
+        used, used_mappers = [], []
+        for f, m in enumerate(all_mappers):
+            if feature_pre_filter and m.is_trivial and mappers is None:
+                continue
+            used.append(f)
+            used_mappers.append(m)
+        if not used:
+            Log.warning("All features are trivial (constant); nothing to learn")
+        used = np.array(used, dtype=np.int32)
+        max_num_bin = max([m.num_bin for m in used_mappers], default=2)
+        dtype = np.uint8 if max_num_bin <= 256 else np.uint16
+        binned = np.empty((num_data, len(used)), dtype=dtype)
+        for j, f in enumerate(used):
+            binned[:, j] = used_mappers[j].values_to_bins(
+                np.asarray(X[:, f], dtype=np.float64)).astype(dtype)
+        return BinnedDataset(binned, used_mappers, used, num_total, metadata,
+                             feature_names)
+
+    # ---- accessors ----------------------------------------------------
+    @property
+    def num_data(self) -> int:
+        return self.bins.shape[0]
+
+    @property
+    def num_features(self) -> int:
+        return self.bins.shape[1]
+
+    def subset(self, row_indices: np.ndarray) -> "BinnedDataset":
+        """Row subset sharing mappers (reference Dataset::CopySubrow)."""
+        md = self.metadata
+        sub_md = Metadata(
+            len(row_indices),
+            None if md.label is None else md.label[row_indices],
+            None if md.weight is None else md.weight[row_indices],
+            None,
+            None if md.init_score is None else md.init_score[row_indices])
+        return BinnedDataset(self.bins[row_indices], self.mappers,
+                             self.used_features, self.num_total_features,
+                             sub_md, self.feature_names)

@@ -1,0 +1,83 @@
+"""Histogram construction: the hottest op of the framework.
+
+Redesign of the reference histogram path (Bin::ConstructHistogram
+dense_bin.hpp:143-160, row-wise MultiValBinWrapper train_share_states.h:37-80,
+and the CUDA shared-memory kernels cuda_histogram_constructor.cu:18-307):
+instead of per-leaf gathers over index ranges, ONE fused pass over all rows
+scatter-adds (grad, hess, count) keyed by (frontier_slot, feature, bin).
+Rows whose node is not being histogrammed this pass are routed to a trash
+slot — shapes stay static, no data-dependent row gathers.
+
+Layout: hist[s, f, b, c] with rectangular bin axis padded to `bmax`
+(per-feature valid-bin masking happens in the split scan). Accumulation in
+float32; channel 2 carries exact data counts (the reference tracks counts
+outside the histogram; keeping them in-band costs 1/3 more HBM but makes
+min_data_in_leaf exact on device).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["build_histograms"]
+
+
+@functools.partial(jax.jit, static_argnames=("num_slots", "bmax",
+                                             "feature_block"))
+def build_histograms(bins: jax.Array, grad: jax.Array, hess: jax.Array,
+                     row_slot: jax.Array, *, num_slots: int, bmax: int,
+                     feature_block: int = 8) -> jax.Array:
+    """Build per-slot histograms.
+
+    Args:
+      bins: [N, F] integer bin matrix (uint8/uint16/int32).
+      grad, hess: [N] float32 gradients/hessians (bagging weights already
+        folded in).
+      row_slot: [N] int32 slot of each row's node; -1 routes to trash.
+      num_slots: static number of live slots S.
+      bmax: static padded bin count per feature.
+      feature_block: features scatter-added per scan step (bounds the
+        transient [N*block] index buffer).
+
+    Returns:
+      hist: [S, F, bmax, 3] float32 (sum_grad, sum_hess, count).
+    """
+    n, f = bins.shape
+    slot = row_slot.astype(jnp.int32)
+    data = jnp.stack([grad, hess, jnp.ones_like(grad)], axis=-1)  # [N, 3]
+
+    fb = min(feature_block, f)
+    num_blocks = (f + fb - 1) // fb
+    pad_f = num_blocks * fb
+    if pad_f != f:
+        bins = jnp.pad(bins, ((0, 0), (0, pad_f - f)))
+    bins_i = bins.astype(jnp.int32)
+
+    # Each scan step scatter-adds one block of `fb` features; every feature
+    # in the block owns its own [S, bmax] plane: id = (slot*fb + j)*bmax + bin.
+    num_seg = (num_slots * fb + 1) * bmax
+    trash = num_slots * fb * bmax
+    blocks = jnp.arange(pad_f, dtype=jnp.int32).reshape(num_blocks, fb)
+
+    def block_step(_, fb_idx):
+        cols = jnp.take(bins_i, fb_idx, axis=1)           # [N, fb]
+        j = jnp.arange(fb, dtype=jnp.int32)[None, :]
+        ids = (slot[:, None] * fb + j) * bmax + cols
+        valid = (fb_idx[None, :] < f) & (slot[:, None] >= 0) & \
+                (slot[:, None] < num_slots)
+        ids = jnp.where(valid, ids, trash)
+        vals = jnp.broadcast_to(data[:, None, :], (n, fb, 3))
+        seg = jax.ops.segment_sum(
+            vals.reshape(n * fb, 3), ids.reshape(n * fb),
+            num_segments=num_seg)
+        return None, seg[:num_slots * fb * bmax].reshape(
+            num_slots, fb, bmax, 3)
+
+    _, hists = jax.lax.scan(block_step, None, blocks)
+    # hists: [num_blocks, S, fb, bmax, 3] -> [S, num_blocks*fb, bmax, 3]
+    hist = jnp.transpose(hists, (1, 0, 2, 3, 4)).reshape(
+        num_slots, pad_f, bmax, 3)
+    return hist[:, :f]

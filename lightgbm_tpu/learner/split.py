@@ -1,0 +1,226 @@
+"""Vectorized best-split search over histograms.
+
+Replaces the reference's per-feature sequential gain scans
+(FeatureHistogram::FindBestThresholdSequentially, feature_histogram.hpp:85-270
+— a compile-time-specialized template over {L1, max_delta_step, smoothing,
+missing-type, NA-direction}) with ONE batched computation over
+[slots, features, bins]: cumulative sums along the bin axis, the closed-form
+gain at every threshold, NA-left/NA-right evaluated as two masked variants,
+and a flat argmax. Categorical one-vs-rest scan included
+(feature_histogram.hpp:278-485; sorted top-k scan lives in
+categorical_sorted_scan below).
+
+All math follows feature_histogram.hpp:737-860:
+  ThresholdL1(s, l1) = sign(s) * max(|s| - l1, 0)
+  output  = -ThresholdL1(g, l1) / (h + l2)            (clipped by max_delta_step,
+                                                       smoothed toward parent)
+  gain(output) = -(2 * ThresholdL1(g, l1) * output + (h + l2) * output^2)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SplitHyperParams", "BestSplits", "find_best_splits",
+           "leaf_output", "leaf_gain"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SplitHyperParams:
+    """Static split-search hyperparameters (subset of Config)."""
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    max_delta_step: float = 0.0
+    path_smooth: float = 0.0
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_threshold: int = 32
+    max_cat_to_onehot: int = 4
+    min_data_per_group: int = 100
+
+
+class BestSplits(NamedTuple):
+    """Per-slot best split (reference SplitInfo, split_info.hpp:22)."""
+    gain: jax.Array          # [S] split gain (already minus gain_shift)
+    feature: jax.Array       # [S] used-feature index, -1 if none
+    threshold_bin: jax.Array  # [S] bin t: left iff bin <= t (== t for 1-hot cat)
+    default_left: jax.Array  # [S] bool, NaN direction
+    left_grad: jax.Array     # [S]
+    left_hess: jax.Array
+    left_count: jax.Array
+    left_output: jax.Array   # [S]
+    right_output: jax.Array  # [S]
+
+
+def _threshold_l1(s, l1):
+    return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
+
+
+def leaf_output(g, h, l1, l2, max_delta_step=0.0, path_smooth=0.0,
+                count=None, parent_output=None):
+    """CalculateSplittedLeafOutput (feature_histogram.hpp:743-764)."""
+    ret = -_threshold_l1(g, l1) / (h + l2)
+    if max_delta_step > 0:
+        ret = jnp.clip(ret, -max_delta_step, max_delta_step)
+    if path_smooth > 0 and count is not None and parent_output is not None:
+        n_over = count / path_smooth
+        ret = ret * n_over / (n_over + 1.0) + parent_output / (n_over + 1.0)
+    return ret
+
+
+def _gain_given_output(g, h, l1, l2, output):
+    """GetLeafGainGivenOutput (feature_histogram.hpp:851-860)."""
+    sg = _threshold_l1(g, l1)
+    return -(2.0 * sg * output + (h + l2) * output * output)
+
+
+def leaf_gain(g, h, l1, l2, max_delta_step=0.0, path_smooth=0.0,
+              count=None, parent_output=None):
+    """GetLeafGain (feature_histogram.hpp:826-842)."""
+    if max_delta_step <= 0 and path_smooth <= 0:
+        sg = _threshold_l1(g, l1)
+        return (sg * sg) / (h + l2)
+    out = leaf_output(g, h, l1, l2, max_delta_step, path_smooth, count,
+                      parent_output)
+    return _gain_given_output(g, h, l1, l2, out)
+
+
+def _split_gain(lg, lh, lc, rg, rh, rc, l1, l2, hp: SplitHyperParams,
+                parent_output):
+    """GetSplitGains without monotone (feature_histogram.hpp:785-806)."""
+    return (leaf_gain(lg, lh, l1, l2, hp.max_delta_step, hp.path_smooth,
+                      lc, parent_output) +
+            leaf_gain(rg, rh, l1, l2, hp.max_delta_step, hp.path_smooth,
+                      rc, parent_output))
+
+
+@functools.partial(jax.jit, static_argnames=("hp",))
+def find_best_splits(hist: jax.Array, parent_grad: jax.Array,
+                     parent_hess: jax.Array, parent_count: jax.Array,
+                     parent_output: jax.Array, num_bins: jax.Array,
+                     missing_is_nan: jax.Array, is_cat: jax.Array,
+                     feature_mask: jax.Array,
+                     hp: SplitHyperParams) -> BestSplits:
+    """Find the best split per slot.
+
+    Args:
+      hist: [S, F, B, 3] (grad, hess, count) histograms.
+      parent_*: [S] node aggregates; parent_output: [S] node output value.
+      num_bins: [F] per-feature bin counts (incl. NaN bin when present).
+      missing_is_nan: [F] bool, feature has a trailing NaN bin.
+      is_cat: [F] bool.
+      feature_mask: [F] float/bool — 0 disables a feature (feature_fraction).
+    """
+    s, f, b, _ = hist.shape
+    l1, l2 = hp.lambda_l1, hp.lambda_l2
+    bins_r = jnp.arange(b, dtype=jnp.int32)
+
+    tot = jnp.stack([parent_grad, parent_hess, parent_count], -1)  # [S, 3]
+    tot = tot[:, None, None, :]                                    # [S,1,1,3]
+
+    # gain_shift: unsmoothed closed-form gain of the unsplit node
+    # (feature_histogram.hpp:295-301 passes USE_SMOOTHING=false here)
+    gain_shift = leaf_gain(parent_grad, parent_hess, l1, l2,
+                           hp.max_delta_step)                      # [S]
+    min_gain_shift = gain_shift + hp.min_gain_to_split
+
+    # ---------- numerical features ----------
+    prefix = jnp.cumsum(hist, axis=2)                              # [S,F,B,3]
+    nan_idx = jnp.maximum(num_bins - 1, 0)
+    nan_sums = jnp.take_along_axis(
+        hist, nan_idx[None, :, None, None].astype(jnp.int32),
+        axis=2)                                                    # [S,F,1,3]
+    nan_sums = jnp.where(missing_is_nan[None, :, None, None], nan_sums, 0.0)
+
+    # threshold t valid iff t <= num_bins-2 (-1 more when NaN bin present)
+    t_limit = num_bins - 2 - missing_is_nan.astype(jnp.int32)      # [F]
+    valid_t = bins_r[None, :] <= t_limit[:, None]                  # [F, B]
+    valid_t &= (~is_cat[:, None]) & (feature_mask[:, None] > 0)
+
+    def eval_option(left):                                         # [S,F,B,3]
+        right = tot - left
+        lg, lh, lc = left[..., 0], left[..., 1], left[..., 2]
+        rg, rh, rc = right[..., 0], right[..., 1], right[..., 2]
+        ok = ((lc >= hp.min_data_in_leaf) & (rc >= hp.min_data_in_leaf) &
+              (lh >= hp.min_sum_hessian_in_leaf) &
+              (rh >= hp.min_sum_hessian_in_leaf))
+        g = _split_gain(lg, lh, lc, rg, rh, rc, l1, l2, hp,
+                        parent_output[:, None, None])
+        return jnp.where(ok & valid_t[None], g, -jnp.inf)
+
+    gain_na_right = eval_option(prefix)                       # NaN stays right
+    gain_na_left = jnp.where(
+        missing_is_nan[None, :, None],
+        eval_option(prefix + nan_sums), -jnp.inf)             # NaN joins left
+
+    # ---------- categorical one-vs-rest ----------
+    # left = single category bin ("bin == t" decision); NaN/unseen (bin 0)
+    # always right. cat_l2/cat_smooth regularization per
+    # feature_histogram.hpp:508-560 (one-hot branch).
+    cat_valid = is_cat[None, :, None] & (feature_mask[None, :, None] > 0) & \
+        (bins_r[None, None, :] >= 1) & \
+        (bins_r[None, None, :] <= (num_bins[None, :, None] - 1))
+    cl2 = l2 + hp.cat_l2
+    lg, lh, lc = hist[..., 0], hist[..., 1], hist[..., 2]
+    rg = tot[..., 0] - lg
+    rh = tot[..., 1] - lh
+    rc = tot[..., 2] - lc
+    cat_ok = ((lc >= hp.min_data_in_leaf) & (rc >= hp.min_data_in_leaf) &
+              (lh >= hp.min_sum_hessian_in_leaf) &
+              (rh >= hp.min_sum_hessian_in_leaf))
+    cat_gain_shift = leaf_gain(parent_grad, parent_hess, l1, cl2,
+                               hp.max_delta_step)
+    cat_gain = (leaf_gain(lg, lh, l1, cl2, hp.max_delta_step, hp.path_smooth,
+                          lc, parent_output[:, None, None]) +
+                leaf_gain(rg, rh, l1, cl2, hp.max_delta_step, hp.path_smooth,
+                          rc, parent_output[:, None, None]))
+    cat_min_shift = (cat_gain_shift + hp.min_gain_to_split)[:, None, None]
+    cat_gain = jnp.where(cat_ok & cat_valid &
+                         (cat_gain > cat_min_shift), cat_gain, -jnp.inf)
+
+    # ---------- combine & argmax ----------
+    num_gain = jnp.maximum(gain_na_right, gain_na_left)
+    num_gain = jnp.where(num_gain > min_gain_shift[:, None, None],
+                         num_gain, -jnp.inf)
+    all_gain = jnp.where(is_cat[None, :, None], cat_gain, num_gain)  # [S,F,B]
+
+    flat = all_gain.reshape(s, f * b)
+    best_idx = jnp.argmax(flat, axis=1)                            # [S]
+    best_gain = jnp.take_along_axis(flat, best_idx[:, None], 1)[:, 0]
+    best_f = (best_idx // b).astype(jnp.int32)
+    best_t = (best_idx % b).astype(jnp.int32)
+    has_split = jnp.isfinite(best_gain)
+
+    sel = (jnp.arange(s), best_f, best_t)
+    chose_na_left = gain_na_left[sel] >= gain_na_right[sel]
+    best_is_cat = is_cat[best_f]
+    left = jnp.where(
+        best_is_cat[:, None], hist[sel],
+        jnp.where(chose_na_left[:, None], (prefix + nan_sums)[sel],
+                  prefix[sel]))                                    # [S, 3]
+    lgs, lhs, lcs = left[..., 0], left[..., 1], left[..., 2]
+    rgs = parent_grad - lgs
+    rhs = parent_hess - lhs
+    rcs = parent_count - lcs
+    eff_l2 = jnp.where(best_is_cat, cl2, l2)
+    lout = leaf_output(lgs, lhs, l1, eff_l2, hp.max_delta_step,
+                       hp.path_smooth, lcs, parent_output)
+    rout = leaf_output(rgs, rhs, l1, eff_l2, hp.max_delta_step,
+                       hp.path_smooth, rcs, parent_output)
+    shift = jnp.where(best_is_cat, cat_gain_shift, gain_shift)
+
+    return BestSplits(
+        gain=jnp.where(has_split, best_gain - shift, -jnp.inf),
+        feature=jnp.where(has_split, best_f, -1),
+        threshold_bin=best_t,
+        default_left=jnp.where(best_is_cat, False, chose_na_left),
+        left_grad=lgs, left_hess=lhs, left_count=lcs,
+        left_output=lout, right_output=rout)

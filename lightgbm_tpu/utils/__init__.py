@@ -1,0 +1,4 @@
+from .log import Log, register_logger
+from .timer import Timer, global_timer
+
+__all__ = ["Log", "register_logger", "Timer", "global_timer"]
