@@ -1,0 +1,449 @@
+"""User-facing Dataset and Booster (reference python-package/lightgbm/basic.py).
+
+The reference reaches the C++ core through ctypes over the 80-function C API
+(c_api.h:53-1361); here `Booster` drives the JAX boosting core directly —
+there is no FFI hop, but the public surface mirrors basic.py:
+`Dataset(data, label, ...)` with lazy construction (basic.py:1163
+_lazy_init) and `Booster(params, train_set)` (basic.py:2594) with
+update/eval/predict/save_model/feature_importance.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .binning import BinMapper
+from .config import Config, param_dict_to_config
+from .data import BinnedDataset, Metadata
+from .metrics import METRIC_ALIASES, create_metric
+from .objectives import create_objective
+from .utils.log import Log, LightGBMError
+
+__all__ = ["Dataset", "Booster", "LightGBMError"]
+
+
+def _to_2d_float(data) -> np.ndarray:
+    if hasattr(data, "values") and not isinstance(data, np.ndarray):
+        data = data.values  # pandas
+    arr = np.asarray(data)
+    if arr.ndim == 1:
+        arr = arr.reshape(-1, 1)
+    if hasattr(data, "tocsr") or hasattr(arr, "toarray"):
+        arr = arr.toarray()
+    return np.ascontiguousarray(arr, dtype=np.float64)
+
+
+def _load_svmlight_or_csv(path: str) -> np.ndarray:
+    """Minimal text loader: CSV/TSV with optional label in first column.
+    (Reference Parser auto-detect, src/io/parser.cpp.)"""
+    with open(path) as fh:
+        first = fh.readline()
+    delim = "\t" if "\t" in first else ","
+    return np.loadtxt(path, delimiter=delim)
+
+
+class Dataset:
+    """Lazily-constructed binned dataset (reference basic.py:1163)."""
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name: Union[str, List[str]] = "auto",
+                 categorical_feature: Union[str, List] = "auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = True):
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params or {})
+        self.free_raw_data = free_raw_data
+        self._binned: Optional[BinnedDataset] = None
+        self.used_indices = None
+
+    # ------------------------------------------------------------------
+    def construct(self) -> "Dataset":
+        if self._binned is not None:
+            return self
+        cfg = param_dict_to_config(self.params)
+        data = self.data
+        if isinstance(data, str):
+            raw = _load_svmlight_or_csv(data)
+            if self.label is None:
+                self.label, raw = raw[:, 0], raw[:, 1:]
+            data = raw
+        X = _to_2d_float(data)
+        names: Optional[List[str]] = None
+        if self.feature_name != "auto" and self.feature_name is not None:
+            names = list(self.feature_name)
+        elif hasattr(self.data, "columns"):
+            names = [str(c) for c in self.data.columns]
+        cat: List[int] = []
+        if self.categorical_feature != "auto" and self.categorical_feature:
+            for c in self.categorical_feature:
+                if isinstance(c, str):
+                    if names and c in names:
+                        cat.append(names.index(c))
+                else:
+                    cat.append(int(c))
+        elif cfg.categorical_feature:
+            cat = [int(c) for c in str(cfg.categorical_feature).split(",")
+                   if c != ""]
+        label = None if self.label is None else \
+            np.asarray(self.label, dtype=np.float32).reshape(-1)
+        md = Metadata(X.shape[0], label=label,
+                      weight=None if self.weight is None else
+                      np.asarray(self.weight, np.float32),
+                      group=None if self.group is None else
+                      np.asarray(self.group),
+                      init_score=None if self.init_score is None else
+                      np.asarray(self.init_score))
+        ref_mappers: Optional[List[BinMapper]] = None
+        if self.reference is not None:
+            self.reference.construct()
+            ref = self.reference._binned
+            # align: valid sets reuse the training BinMappers
+            # (reference LoadFromFileAlignWithOtherDataset,
+            # dataset_loader.cpp:299)
+            full = [None] * ref.num_total_features
+            for j, f in enumerate(ref.used_features):
+                full[int(f)] = ref.mappers[j]
+            trivial = BinMapper()
+            ref_mappers = [m if m is not None else trivial for m in full]
+            self._binned = BinnedDataset.from_raw(
+                X, md, max_bin=cfg.max_bin,
+                min_data_in_bin=cfg.min_data_in_bin,
+                mappers=ref_mappers, feature_names=names,
+                feature_pre_filter=False)
+            # keep only the reference's used features
+            keep = ref.used_features
+            self._binned = BinnedDataset(
+                self._binned.bins[:, keep], [ref_mappers[int(f)] for f in keep],
+                keep, ref.num_total_features, md, names)
+        else:
+            self._binned = BinnedDataset.from_raw(
+                X, md, max_bin=cfg.max_bin,
+                min_data_in_bin=cfg.min_data_in_bin,
+                sample_cnt=cfg.bin_construct_sample_cnt,
+                use_missing=cfg.use_missing,
+                zero_as_missing=cfg.zero_as_missing,
+                categorical_features=cat, seed=cfg.data_random_seed,
+                feature_names=names,
+                feature_pre_filter=cfg.feature_pre_filter)
+        if self.free_raw_data:
+            self.data = None
+        return self
+
+    # ------------------------------------------------------------------
+    def num_data(self) -> int:
+        self.construct()
+        return self._binned.num_data
+
+    def num_feature(self) -> int:
+        self.construct()
+        return self._binned.num_total_features
+
+    def get_label(self):
+        if self.label is not None:
+            return np.asarray(self.label)
+        if self._binned is not None:
+            return self._binned.metadata.label
+        return None
+
+    def get_weight(self):
+        return self.weight
+
+    def get_group(self):
+        return self.group
+
+    def get_init_score(self):
+        return self.init_score
+
+    def set_label(self, label):
+        self.label = label
+        if self._binned is not None:
+            self._binned.metadata.label = np.asarray(
+                label, np.float32).reshape(-1)
+        return self
+
+    def set_weight(self, weight):
+        self.weight = weight
+        if self._binned is not None and weight is not None:
+            self._binned.metadata.weight = np.asarray(weight, np.float32)
+        return self
+
+    def set_group(self, group):
+        self.group = group
+        if self._binned is not None and group is not None:
+            self._binned.metadata.__init__(
+                self._binned.num_data, self._binned.metadata.label,
+                self._binned.metadata.weight, np.asarray(group),
+                self._binned.metadata.init_score)
+        return self
+
+    def set_init_score(self, init_score):
+        self.init_score = init_score
+        return self
+
+    def set_field(self, name, data):
+        return {"label": self.set_label, "weight": self.set_weight,
+                "group": self.set_group,
+                "init_score": self.set_init_score}[name](data)
+
+    def get_field(self, name):
+        return {"label": self.get_label, "weight": self.get_weight,
+                "group": self.get_group,
+                "init_score": self.get_init_score}[name]()
+
+    def subset(self, used_indices: Sequence[int], params=None) -> "Dataset":
+        self.construct()
+        sub = Dataset(None, params=params or self.params)
+        sub._binned = self._binned.subset(np.asarray(used_indices))
+        sub.reference = self
+        return sub
+
+    @property
+    def binned(self) -> BinnedDataset:
+        self.construct()
+        return self._binned
+
+
+class Booster:
+    """Training/prediction handle (reference basic.py:2594 + c_api.cpp:106).
+
+    Thread-safety note: the reference guards the C Booster with a
+    shared_mutex (c_api.cpp:827); here the GIL plus JAX's functional arrays
+    make mutation points (update/save) naturally serialized.
+    """
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
+        from .boosting.gbdt import create_boosting
+        self.params = dict(params or {})
+        self.config = param_dict_to_config(self.params)
+        Log.set_verbosity(self.config.verbosity)
+        self._model = None          # HostModel once finalized/loaded
+        self.gbdt = None
+        self.train_set = None
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._train_metric_objs = []
+        if model_file is not None:
+            with open(model_file) as fh:
+                model_str = fh.read()
+        if model_str is not None:
+            from .tree import HostModel
+            self._model = HostModel.from_string(model_str)
+            return
+        if train_set is None:
+            raise LightGBMError("Booster needs train_set or a model")
+        if not isinstance(train_set, Dataset):
+            raise TypeError("train_set must be a Dataset")
+        self.train_set = train_set
+        merged = dict(train_set.params)
+        merged.update(self.params)
+        train_set.params = merged
+        train_set.construct()
+        cfg = self.config
+        objective = create_objective(cfg.objective, cfg)
+        metric_names = cfg.metric_list()
+        if not metric_names and cfg.objective in METRIC_ALIASES:
+            metric_names = [cfg.objective]
+        metrics = [m for m in (create_metric(nm, cfg) for nm in metric_names)
+                   if m is not None]
+        binned = train_set.binned
+        for m in metrics:
+            m.init(binned.metadata, binned.num_data)
+        self._metric_names = metric_names
+        self.gbdt = create_boosting(cfg, binned, objective,
+                                    metrics if cfg.is_provide_training_metric
+                                    else metrics)
+        self.name_valid_sets: List[str] = []
+        self._valid_data: List[Dataset] = []
+
+    # ------------------------------------------------------------------
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        data.reference = self.train_set
+        data.construct()
+        cfg = self.config
+        metrics = [m for m in (create_metric(nm, cfg)
+                               for nm in self._metric_names) if m is not None]
+        for m in metrics:
+            m.init(data.binned.metadata, data.binned.num_data)
+        self.gbdt.add_valid(data.binned, name, metrics)
+        self.name_valid_sets.append(name)
+        self._valid_data.append(data)
+        return self
+
+    def update(self, train_set=None, fobj=None) -> bool:
+        """One boosting iteration; returns True if no further splits
+        (reference LGBM_BoosterUpdateOneIter)."""
+        self._model = None
+        if fobj is not None:
+            import jax.numpy as jnp
+            score = self.gbdt.train_score
+            grad, hess = fobj(np.asarray(score), self.train_set)
+            return self.gbdt.train_one_iter(
+                jnp.asarray(grad, jnp.float32).reshape(score.shape),
+                jnp.asarray(hess, jnp.float32).reshape(score.shape))
+        return self.gbdt.train_one_iter()
+
+    def rollback_one_iter(self) -> "Booster":
+        self._model = None
+        self.gbdt.rollback_one_iter()
+        return self
+
+    def current_iteration(self) -> int:
+        if self.gbdt is not None:
+            return self.gbdt.current_iteration()
+        return self._model.num_iterations if self._model else 0
+
+    @property
+    def num_trees_per_iteration(self) -> int:
+        if self.gbdt is not None:
+            return self.gbdt.num_tree_per_iteration
+        return self._model.num_tree_per_iteration if self._model else 1
+
+    def num_model_per_iteration(self) -> int:
+        return self.num_trees_per_iteration
+
+    def num_trees(self) -> int:
+        if self.gbdt is not None:
+            return len(self.gbdt.trees)
+        return len(self._model.trees) if self._model else 0
+
+    # ------------------------------------------------------------------
+    def eval_train(self, feval=None) -> List:
+        res = []
+        for name, val in self.gbdt.eval_train().items():
+            higher = name in ("auc", "ndcg", "map", "average_precision",
+                              "auc_mu") or name.split("@")[0] in ("ndcg", "map")
+            res.append(("training", name, val, higher))
+        res.extend(self._custom_eval(feval, "training", None))
+        return res
+
+    def eval_valid(self, feval=None) -> List:
+        res = []
+        for i, name in enumerate(self.name_valid_sets):
+            for mname, val in self.gbdt.eval_valid(i).items():
+                higher = mname.split("@")[0] in (
+                    "auc", "ndcg", "map", "average_precision", "auc_mu")
+                res.append((name, mname, val, higher))
+            res.extend(self._custom_eval(feval, name, i))
+        return res
+
+    def _custom_eval(self, feval, data_name, valid_idx):
+        if feval is None:
+            return []
+        funcs = feval if isinstance(feval, (list, tuple)) else [feval]
+        if valid_idx is None:
+            score, data = self.gbdt.train_score, self.train_set
+        else:
+            score, data = self.gbdt.valid_scores[valid_idx], \
+                self._valid_data[valid_idx]
+        out = []
+        for fn in funcs:
+            r = fn(np.asarray(score), data)
+            rs = r if isinstance(r, list) else [r]
+            for name, val, higher in rs:
+                out.append((data_name, name, val, higher))
+        return out
+
+    # ------------------------------------------------------------------
+    def _host_model(self):
+        from .tree import HostModel
+        if self._model is None:
+            self._model = HostModel.from_gbdt(self.gbdt, self.train_set)
+        return self._model
+
+    def predict(self, data, start_iteration: int = 0,
+                num_iteration: Optional[int] = None, raw_score: bool = False,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                validate_features: bool = False, **kwargs) -> np.ndarray:
+        model = self._host_model()
+        X = _to_2d_float(data)
+        return model.predict(X, start_iteration=start_iteration,
+                             num_iteration=num_iteration, raw_score=raw_score,
+                             pred_leaf=pred_leaf, pred_contrib=pred_contrib)
+
+    def refit(self, data, label, decay_rate: Optional[float] = None,
+              **kwargs) -> "Booster":
+        """Refit leaf values on new data (reference gbdt.cpp:287 RefitTree)."""
+        model = self._host_model()
+        decay = self.config.refit_decay_rate if decay_rate is None \
+            else decay_rate
+        new_model = model.refit(_to_2d_float(data),
+                                np.asarray(label, np.float32), decay,
+                                self.config)
+        new_booster = Booster(params=self.params,
+                              model_str=new_model.to_string())
+        return new_booster
+
+    # ------------------------------------------------------------------
+    def save_model(self, filename: str, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0,
+                   importance_type: str = "split") -> "Booster":
+        with open(filename, "w") as fh:
+            fh.write(self.model_to_string(num_iteration, start_iteration,
+                                          importance_type))
+        return self
+
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0,
+                        importance_type: str = "split") -> str:
+        return self._host_model().to_string(
+            num_iteration=num_iteration, start_iteration=start_iteration)
+
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0,
+                   importance_type: str = "split") -> dict:
+        return self._host_model().to_json(num_iteration, start_iteration)
+
+    # ------------------------------------------------------------------
+    def feature_name(self) -> List[str]:
+        return self._host_model().feature_names
+
+    def num_feature(self) -> int:
+        return self._host_model().max_feature_idx + 1
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        return self._host_model().feature_importance(importance_type)
+
+    def lower_bound(self):
+        model = self._host_model()
+        return min((t.leaf_value.min() for t in model.trees), default=0.0)
+
+    def upper_bound(self):
+        model = self._host_model()
+        return max((t.leaf_value.max() for t in model.trees), default=0.0)
+
+    def free_dataset(self) -> "Booster":
+        self.train_set = None
+        return self
+
+    def free_network(self) -> "Booster":
+        return self
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        self.params.update(params)
+        self.config.update(params)
+        if self.gbdt is not None:
+            self.gbdt.shrinkage_rate = float(self.config.learning_rate)
+            self.gbdt.config = self.config
+        return self
+
+    def __copy__(self):
+        return self.__deepcopy__(None)
+
+    def __deepcopy__(self, _):
+        return Booster(params=self.params, model_str=self.model_to_string())

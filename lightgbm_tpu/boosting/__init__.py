@@ -1,0 +1,3 @@
+from .gbdt import GBDT, create_boosting
+
+__all__ = ["GBDT", "create_boosting"]

@@ -1,0 +1,150 @@
+"""DART boosting: dropout trees + normalization (reference dart.hpp:23-211).
+
+Per iteration: select dropped trees (by rate or uniform-one, optionally
+weighted by tree weight), subtract their contribution from all scores, train
+on the modified gradients, then normalize the new tree and the dropped trees
+(xgboost_dart_mode supported). Tree weights tracked per tree.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..learner.predict import predict_binned_tree
+from ..utils.log import Log
+from .gbdt import GBDT
+
+__all__ = ["DART"]
+
+
+class DART(GBDT):
+    def __init__(self, config, train_set, objective, metrics):
+        super().__init__(config, train_set, objective, metrics)
+        self.tree_weights: List[float] = []
+        self.drop_indices: List[int] = []
+        self.sum_weight = 0.0
+        self._random = np.random.RandomState(config.drop_seed)
+        self.shrinkage_rate = float(config.learning_rate)
+
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        self._select_dropping_trees()
+        self._drop_trees()
+        stop = super().train_one_iter(gradients, hessians)
+        self._normalize()
+        return stop
+
+    # reference dart.hpp:95-125 DroppingTrees
+    def _select_dropping_trees(self) -> None:
+        cfg = self.config
+        k = self.num_tree_per_iteration
+        num_iters_done = len(self.trees) // k
+        self.drop_indices = []
+        if num_iters_done == 0:
+            return
+        if cfg.uniform_drop:
+            for i in range(num_iters_done):
+                if self._random.rand() < cfg.drop_rate:
+                    self.drop_indices.append(i)
+        else:
+            w = np.asarray(self.tree_weights[:num_iters_done])
+            p = w / max(w.sum(), 1e-15)
+            for i in range(num_iters_done):
+                if self._random.rand() < cfg.drop_rate * p[i] * \
+                        num_iters_done:
+                    self.drop_indices.append(i)
+        if len(self.drop_indices) > cfg.max_drop > 0:
+            self._random.shuffle(self.drop_indices)
+            self.drop_indices = sorted(self.drop_indices[:cfg.max_drop])
+        if not self.drop_indices and num_iters_done > 0 and \
+                self._random.rand() >= self.config.skip_drop:
+            self.drop_indices = [self._random.randint(num_iters_done)]
+
+    def _tree_delta(self, it: int, cls: int, factor: float):
+        tree = self.trees[it * self.num_tree_per_iteration + cls]
+        scaled = tree._replace(leaf_value=tree.leaf_value * factor)
+        return scaled
+
+    def _apply_tree_to_scores(self, it: int, cls: int, factor: float) -> None:
+        k = self.num_tree_per_iteration
+        tree = self.trees[it * k + cls]
+        vals = predict_binned_tree(tree, self.bins, self.num_bins_d,
+                                   self.missing_is_nan_d) * factor
+        if k == 1:
+            self.train_score = self.train_score + vals
+        else:
+            self.train_score = self.train_score.at[:, cls].add(vals)
+        for i in range(len(self.valid_sets)):
+            vv = predict_binned_tree(tree, self.valid_bins[i],
+                                     self.num_bins_d,
+                                     self.missing_is_nan_d) * factor
+            if k == 1:
+                self.valid_scores[i] = self.valid_scores[i] + vv
+            else:
+                self.valid_scores[i] = self.valid_scores[i].at[:, cls].add(vv)
+
+    def _drop_trees(self) -> None:
+        for it in self.drop_indices:
+            for cls in range(self.num_tree_per_iteration):
+                self._apply_tree_to_scores(it, cls, -1.0)
+        if not self.config.xgboost_dart_mode:
+            self.shrinkage_rate = float(self.config.learning_rate)
+        else:
+            self.shrinkage_rate = float(self.config.learning_rate) / \
+                max(1.0, 1.0 + len(self.drop_indices))
+
+    # reference dart.hpp:127-181 Normalize
+    def _normalize(self) -> None:
+        cfg = self.config
+        k_drop = len(self.drop_indices)
+        k = self.num_tree_per_iteration
+        if cfg.xgboost_dart_mode:
+            new_factor = 1.0  # folded into shrinkage above
+            old_factor = k_drop / (k_drop + float(cfg.learning_rate)) \
+                if k_drop > 0 else 1.0
+        else:
+            new_factor = 1.0 / (k_drop + 1.0)
+            old_factor = k_drop / (k_drop + 1.0)
+        # scale the new trees (trained this iter) by new_factor
+        for cls in range(k):
+            idx = len(self.trees) - k + cls
+            tree = self.trees[idx]
+            if new_factor != 1.0:
+                # remove over-counted part from scores
+                vals = predict_binned_tree(
+                    tree, self.bins, self.num_bins_d,
+                    self.missing_is_nan_d) * (new_factor - 1.0)
+                cls_id = self.tree_class[idx]
+                if k == 1:
+                    self.train_score = self.train_score + vals
+                else:
+                    self.train_score = \
+                        self.train_score.at[:, cls_id].add(vals)
+                for i in range(len(self.valid_sets)):
+                    vv = predict_binned_tree(
+                        tree, self.valid_bins[i], self.num_bins_d,
+                        self.missing_is_nan_d) * (new_factor - 1.0)
+                    if k == 1:
+                        self.valid_scores[i] = self.valid_scores[i] + vv
+                    else:
+                        self.valid_scores[i] = \
+                            self.valid_scores[i].at[:, cls_id].add(vv)
+                self.trees[idx] = tree._replace(
+                    leaf_value=tree.leaf_value * new_factor)
+        self.tree_weights.append(new_factor)
+        # scale dropped trees back in with old_factor
+        for it in self.drop_indices:
+            for cls in range(k):
+                self._apply_tree_to_scores(it, cls, old_factor)
+                idx = it * k + cls
+                self.trees[idx] = self.trees[idx]._replace(
+                    leaf_value=self.trees[idx].leaf_value * old_factor)
+            self.tree_weights[it] *= old_factor
+        if self.drop_indices:
+            Log.debug("DART: dropped %d trees", len(self.drop_indices))
+
+
+# DART trees already carry their weights inside leaf_value; prediction and
+# serialization need no special casing.

