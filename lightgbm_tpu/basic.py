@@ -322,13 +322,15 @@ class Booster:
         return len(self._model.trees) if self._model else 0
 
     # ------------------------------------------------------------------
+    train_data_name = "training"
+
     def eval_train(self, feval=None) -> List:
         res = []
         for name, val in self.gbdt.eval_train().items():
             higher = name in ("auc", "ndcg", "map", "average_precision",
                               "auc_mu") or name.split("@")[0] in ("ndcg", "map")
-            res.append(("training", name, val, higher))
-        res.extend(self._custom_eval(feval, "training", None))
+            res.append((self.train_data_name, name, val, higher))
+        res.extend(self._custom_eval(feval, self.train_data_name, None))
         return res
 
     def eval_valid(self, feval=None) -> List:

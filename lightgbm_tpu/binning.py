@@ -216,7 +216,12 @@ class BinMapper:
                 m.missing_type = MissingType.NONE
         m.bin_upper_bound = np.asarray(bounds, dtype=np.float64)
         m.num_bin = len(bounds)
-        m.is_trivial = m.num_bin <= 1
+        # trivial when all data lands in one bin (constant feature) —
+        # reference prunes via is_trivial + feature_pre_filter
+        occupied = len(np.unique(m.values_to_bins_numeric_only(distinct)))
+        if na_cnt > 0:
+            occupied += 1
+        m.is_trivial = m.num_bin <= 1 or occupied <= 1
         m.default_bin = m._value_to_bin_scalar(0.0)
         if total_sample_cnt > 0:
             m.sparse_rate = zero_cnt / total_sample_cnt
@@ -269,6 +274,14 @@ class BinMapper:
     # ---- mapping ------------------------------------------------------
     def _value_to_bin_scalar(self, value: float) -> int:
         return int(self.values_to_bins(np.array([value]))[0])
+
+    def values_to_bins_numeric_only(self, values: np.ndarray) -> np.ndarray:
+        """Bin finite values during construction (no NaN branch needed)."""
+        n_numeric = self.num_bin
+        if self.missing_type == MissingType.NAN:
+            n_numeric -= 1
+        search_bounds = self.bin_upper_bound[:max(n_numeric - 1, 0)]
+        return np.searchsorted(search_bounds, values, side="left")
 
     def values_to_bins(self, values: np.ndarray) -> np.ndarray:
         """Vectorized value->bin (reference bin.h:149 ValueToBin)."""

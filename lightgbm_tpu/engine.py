@@ -80,6 +80,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
             reduced_valid_sets.append(valid_data)
             name_valid_sets.append(valid_names[i] if valid_names is not None
                                    else f"valid_{i}")
+    booster.train_data_name = train_data_name
     for vd, name in zip(reduced_valid_sets, name_valid_sets):
         booster.add_valid(vd, name)
 

@@ -58,6 +58,7 @@ class BestSplits(NamedTuple):
     left_count: jax.Array
     left_output: jax.Array   # [S]
     right_output: jax.Array  # [S]
+    per_feature_gain: jax.Array  # [S, F] best gain per feature (for voting)
 
 
 def _threshold_l1(s, l1):
@@ -117,7 +118,8 @@ def find_best_splits(hist: jax.Array, parent_grad: jax.Array,
       num_bins: [F] per-feature bin counts (incl. NaN bin when present).
       missing_is_nan: [F] bool, feature has a trailing NaN bin.
       is_cat: [F] bool.
-      feature_mask: [F] float/bool — 0 disables a feature (feature_fraction).
+      feature_mask: [F] or [S, F] float/bool — 0 disables a feature
+        (feature_fraction / feature-parallel shard / voting selection).
     """
     s, f, b, _ = hist.shape
     l1, l2 = hp.lambda_l1, hp.lambda_l2

@@ -1,0 +1,58 @@
+"""Test env: CPU backend with 8 virtual devices for sharding tests.
+
+Reference test strategy (SURVEY.md §4): distributed tests run N processes on
+localhost sockets (tests/distributed/_test_distributed.py). The TPU-native
+equivalent is a virtual multi-device CPU mesh — same collectives, no pod.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: harness may preset 'axon' (TPU)
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") +
+    " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)
+
+
+def make_binary(n=2000, f=10, seed=0):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, f)
+    logit = X[:, 0] * 1.5 + 0.5 * X[:, 1] ** 2 - X[:, 2] + 0.3 * r.randn(n)
+    y = (logit > np.median(logit)).astype(np.float32)
+    return X, y
+
+
+def make_regression(n=2000, f=10, seed=0):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, f)
+    y = (X[:, 0] * 2 + X[:, 1] ** 2 - 0.5 * X[:, 2] +
+         0.1 * r.randn(n)).astype(np.float32)
+    return X, y
+
+
+def make_multiclass(n=3000, f=10, k=4, seed=0):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, f)
+    centers = r.randn(k, f) * 2
+    logits = X @ centers.T + 0.5 * r.randn(n, k)
+    y = logits.argmax(1).astype(np.float32)
+    return X, y
+
+
+def make_ranking(num_queries=100, docs_per_query=20, f=10, seed=0):
+    r = np.random.RandomState(seed)
+    n = num_queries * docs_per_query
+    X = r.randn(n, f)
+    rel = X[:, 0] + 0.5 * X[:, 1] + 0.5 * r.randn(n)
+    # map to 0-4 labels by quantile
+    qs = np.quantile(rel, [0.5, 0.75, 0.9, 0.97])
+    y = np.digitize(rel, qs).astype(np.float32)
+    group = np.full(num_queries, docs_per_query)
+    return X, y, group

@@ -1,0 +1,195 @@
+"""Dataset/binning/config tests (reference tests/python_package_test/test_basic.py)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.binning import BinMapper, MissingType, find_bin_mappers
+from lightgbm_tpu.config import Config, parse_config_file
+from lightgbm_tpu.data import BinnedDataset, Metadata
+
+
+class TestConfig:
+    def test_defaults(self):
+        cfg = Config()
+        assert cfg.num_leaves == 31
+        assert cfg.learning_rate == 0.1
+        assert cfg.max_bin == 255
+        assert cfg.objective == "regression"
+
+    def test_aliases(self):
+        cfg = Config({"n_estimators": 50, "eta": 0.3, "min_child_samples": 5,
+                      "reg_lambda": 1.5, "subsample": 0.8})
+        assert cfg.num_iterations == 50
+        assert cfg.learning_rate == 0.3
+        assert cfg.min_data_in_leaf == 5
+        assert cfg.lambda_l2 == 1.5
+        assert cfg.bagging_fraction == 0.8
+
+    def test_string_coercion(self):
+        cfg = Config({"num_leaves": "63", "feature_fraction": "0.5",
+                      "is_unbalance": "true"})
+        assert cfg.num_leaves == 63
+        assert cfg.feature_fraction == 0.5
+        assert cfg.is_unbalance is True
+
+    def test_invalid_value(self):
+        with pytest.raises(ValueError):
+            Config({"bagging_fraction": 1.5})
+
+    def test_max_depth_caps_leaves(self):
+        cfg = Config({"max_depth": 3, "num_leaves": 100})
+        assert cfg.num_leaves == 8
+
+    def test_goss_disables_bagging(self):
+        cfg = Config({"boosting": "goss", "bagging_freq": 5,
+                      "bagging_fraction": 0.5})
+        assert cfg.bagging_freq == 0
+        assert cfg.bagging_fraction == 1.0
+
+    def test_config_file_parsing(self, tmp_path):
+        p = tmp_path / "train.conf"
+        p.write_text("task = train\n# comment\nnum_leaves=7 # inline\n\n")
+        kv = parse_config_file(str(p))
+        assert kv == {"task": "train", "num_leaves": "7"}
+
+    def test_metric_list(self):
+        cfg = Config({"metric": "auc,binary_logloss"})
+        assert cfg.metric_list() == ["auc", "binary_logloss"]
+
+
+class TestBinMapper:
+    def test_simple_uniform(self):
+        vals = np.linspace(-1, 1, 1000)
+        m = BinMapper.from_sample(vals, 1000, max_bin=16, min_data_in_bin=1)
+        assert 2 < m.num_bin <= 16
+        bins = m.values_to_bins(vals)
+        assert bins.min() == 0
+        assert bins.max() == m.num_bin - 1
+        # monotone: larger value -> same or larger bin
+        assert np.all(np.diff(bins) >= 0)
+
+    def test_few_distinct(self):
+        vals = np.repeat([1.0, 2.0, 3.0], 100)
+        m = BinMapper.from_sample(vals, 300, max_bin=255, min_data_in_bin=3)
+        bins = m.values_to_bins(np.array([1.0, 2.0, 3.0]))
+        assert len(set(bins.tolist())) == 3
+
+    def test_zero_gets_own_bin(self):
+        vals = np.concatenate([np.full(50, -1.0), np.full(100, 1.0)])
+        m = BinMapper.from_sample(vals, 300, max_bin=16, min_data_in_bin=1)
+        bz = m.values_to_bins(np.array([0.0]))[0]
+        bneg = m.values_to_bins(np.array([-1.0]))[0]
+        bpos = m.values_to_bins(np.array([1.0]))[0]
+        assert bneg < bz < bpos
+        assert m.default_bin == bz
+
+    def test_nan_bin(self):
+        vals = np.concatenate([np.random.RandomState(0).randn(500),
+                               np.full(100, np.nan)])
+        m = BinMapper.from_sample(vals, 600, max_bin=32, min_data_in_bin=1)
+        assert m.missing_type == MissingType.NAN
+        b = m.values_to_bins(np.array([np.nan]))[0]
+        assert b == m.num_bin - 1
+
+    def test_no_missing(self):
+        vals = np.random.RandomState(0).randn(500)
+        m = BinMapper.from_sample(vals, 500, max_bin=32, min_data_in_bin=1)
+        assert m.missing_type == MissingType.NONE
+
+    def test_categorical(self):
+        r = np.random.RandomState(0)
+        vals = r.choice([0, 1, 2, 5, 9], size=1000,
+                        p=[0.4, 0.3, 0.2, 0.05, 0.05]).astype(float)
+        m = BinMapper.from_sample(vals, 1000, max_bin=255,
+                                  is_categorical=True)
+        assert m.is_categorical
+        # most frequent category -> bin 1 (bin 0 is the NaN dummy)
+        assert m.categorical_2_bin[0] == 1
+        bins = m.values_to_bins(np.array([0.0, 1.0, 777.0, np.nan]))
+        assert bins[0] == 1
+        assert bins[2] == 0  # unseen -> dummy
+        assert bins[3] == 0  # nan -> dummy
+
+    def test_serialization_roundtrip(self):
+        vals = np.random.RandomState(0).randn(500)
+        m = BinMapper.from_sample(vals, 500, max_bin=64, min_data_in_bin=1)
+        m2 = BinMapper.from_dict(m.to_dict())
+        test = np.random.RandomState(1).randn(100)
+        np.testing.assert_array_equal(m.values_to_bins(test),
+                                      m2.values_to_bins(test))
+
+    def test_max_bin_respected(self):
+        for mb in (3, 15, 63, 255):
+            vals = np.random.RandomState(0).randn(10000)
+            m = BinMapper.from_sample(vals, 10000, max_bin=mb,
+                                      min_data_in_bin=1)
+            assert m.num_bin <= mb
+
+
+class TestBinnedDataset:
+    def test_construct(self):
+        X = np.random.RandomState(0).randn(500, 5)
+        ds = BinnedDataset.from_raw(X, Metadata(500), max_bin=63)
+        assert ds.num_data == 500
+        assert ds.num_features == 5
+        assert ds.bins.dtype == np.uint8
+        assert ds.total_bins == ds.num_bins.sum()
+
+    def test_trivial_feature_filtered(self):
+        X = np.random.RandomState(0).randn(500, 3)
+        X[:, 1] = 7.0  # constant
+        ds = BinnedDataset.from_raw(X, Metadata(500), max_bin=63)
+        assert ds.num_features == 2
+        assert list(ds.used_features) == [0, 2]
+
+    def test_subset(self):
+        X = np.random.RandomState(0).randn(500, 5)
+        y = np.random.RandomState(0).rand(500).astype(np.float32)
+        ds = BinnedDataset.from_raw(X, Metadata(500, label=y), max_bin=63)
+        sub = ds.subset(np.arange(100))
+        assert sub.num_data == 100
+        np.testing.assert_array_equal(sub.bins, ds.bins[:100])
+
+    def test_metadata_validation(self):
+        with pytest.raises(Exception):
+            Metadata(100, label=np.zeros(50, np.float32))
+
+    def test_query_boundaries(self):
+        md = Metadata(100, label=np.zeros(100, np.float32),
+                      group=np.full(10, 10))
+        assert md.num_queries == 10
+        assert md.query_boundaries[-1] == 100
+        qids = md.query_ids()
+        assert len(qids) == 100
+        assert qids[0] == 0 and qids[-1] == 9
+
+
+class TestDatasetAPI:
+    def test_lazy_construction(self):
+        X = np.random.RandomState(0).randn(100, 4)
+        y = np.zeros(100, np.float32)
+        d = lgb.Dataset(X, label=y)
+        assert d._binned is None
+        d.construct()
+        assert d._binned is not None
+        assert d.num_data() == 100
+        assert d.num_feature() == 4
+
+    def test_reference_alignment(self):
+        X = np.random.RandomState(0).randn(300, 4)
+        y = np.zeros(300, np.float32)
+        dtrain = lgb.Dataset(X[:200], label=y[:200])
+        dvalid = lgb.Dataset(X[200:], label=y[200:], reference=dtrain)
+        dtrain.construct()
+        dvalid.construct()
+        # same mappers => same bin boundaries
+        for m1, m2 in zip(dtrain.binned.mappers, dvalid.binned.mappers):
+            np.testing.assert_array_equal(m1.bin_upper_bound,
+                                          m2.bin_upper_bound)
+
+    def test_set_get_field(self):
+        X = np.random.RandomState(0).randn(100, 4)
+        d = lgb.Dataset(X, label=np.zeros(100))
+        d.set_weight(np.ones(100))
+        assert d.get_field("weight") is not None

@@ -1,0 +1,371 @@
+"""Training-engine tests over the objective/metric matrix
+(reference tests/python_package_test/test_engine.py)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.callback import (EarlyStopException, early_stopping,
+                                   log_evaluation, record_evaluation,
+                                   reset_parameter)
+from lightgbm_tpu.metrics import AUCMetric
+
+from conftest import make_binary, make_multiclass, make_ranking, \
+    make_regression
+
+
+def _auc(score, y):
+    return AUCMetric._auc_fast(score, y > 0, np.ones(len(y)))
+
+
+class TestRegression:
+    def test_l2(self):
+        X, y = make_regression()
+        dtrain = lgb.Dataset(X[:1600], label=y[:1600])
+        dvalid = lgb.Dataset(X[1600:], label=y[1600:], reference=dtrain)
+        evals = {}
+        bst = lgb.train({"objective": "regression", "metric": "l2",
+                         "num_leaves": 15, "verbosity": -1},
+                        dtrain, 50, valid_sets=[dvalid],
+                        callbacks=[record_evaluation(evals)])
+        l2 = evals["valid_0"]["l2"]
+        assert l2[-1] < l2[0] * 0.2
+        pred = bst.predict(X[1600:])
+        mse = float(np.mean((pred - y[1600:]) ** 2))
+        assert mse == pytest.approx(l2[-1], rel=1e-4)
+
+    @pytest.mark.parametrize("objective", ["regression_l1", "huber", "fair",
+                                           "quantile", "mape"])
+    def test_l1_family(self, objective):
+        X, y = make_regression()
+        y = y - y.min() + 1.0
+        dtrain = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": objective, "verbosity": -1,
+                         "num_leaves": 15}, dtrain, 30)
+        pred = bst.predict(X)
+        mae = float(np.mean(np.abs(pred - y)))
+        base = float(np.mean(np.abs(np.median(y) - y)))
+        assert mae < base * 0.8
+
+    @pytest.mark.parametrize("objective", ["poisson", "gamma", "tweedie"])
+    def test_log_link_family(self, objective):
+        X, y = make_regression()
+        y = np.exp((y - y.mean()) / (2 * y.std())).astype(np.float32)
+        dtrain = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": objective, "verbosity": -1,
+                         "num_leaves": 15}, dtrain, 40)
+        pred = bst.predict(X)
+        assert np.all(pred > 0)  # log link => positive predictions
+        corr = np.corrcoef(pred, y)[0, 1]
+        assert corr > 0.8
+
+    def test_quantile_coverage(self):
+        X, y = make_regression(n=4000)
+        for alpha in (0.1, 0.9):
+            dtrain = lgb.Dataset(X, label=y)
+            bst = lgb.train({"objective": "quantile", "alpha": alpha,
+                             "verbosity": -1, "num_leaves": 31},
+                            dtrain, 60)
+            cover = float(np.mean(y <= bst.predict(X)))
+            assert abs(cover - alpha) < 0.08, (alpha, cover)
+
+
+class TestBinary:
+    def test_auc_improves(self):
+        X, y = make_binary()
+        dtrain = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "binary", "verbosity": -1},
+                        dtrain, 30)
+        assert _auc(bst.predict(X), y) > 0.95
+
+    def test_unbalance_and_scale_pos_weight_conflict(self):
+        X, y = make_binary()
+        with pytest.raises(Exception):
+            lgb.train({"objective": "binary", "is_unbalance": True,
+                       "scale_pos_weight": 2.0, "verbosity": -1},
+                      lgb.Dataset(X, label=y), 2)
+
+    def test_weights(self):
+        X, y = make_binary()
+        w = np.where(y > 0, 2.0, 1.0).astype(np.float32)
+        dtrain = lgb.Dataset(X, label=y, weight=w)
+        bst = lgb.train({"objective": "binary", "verbosity": -1}, dtrain, 20)
+        assert _auc(bst.predict(X), y) > 0.9
+
+    def test_sigmoid_param(self):
+        X, y = make_binary()
+        bst = lgb.train({"objective": "binary", "sigmoid": 2.0,
+                         "verbosity": -1}, lgb.Dataset(X, label=y), 10)
+        assert "sigmoid:2" in bst._host_model().objective
+
+
+class TestMulticlass:
+    def test_softmax(self):
+        X, y = make_multiclass()
+        dtrain = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "multiclass", "num_class": 4,
+                         "metric": "multi_logloss", "verbosity": -1},
+                        dtrain, 25)
+        pred = bst.predict(X)
+        assert pred.shape == (len(y), 4)
+        np.testing.assert_allclose(pred.sum(1), 1.0, rtol=1e-5)
+        acc = float(np.mean(pred.argmax(1) == y))
+        assert acc > 0.85
+
+    def test_ova(self):
+        X, y = make_multiclass(n=1500)
+        dtrain = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "multiclassova", "num_class": 4,
+                         "verbosity": -1}, dtrain, 20)
+        pred = bst.predict(X)
+        acc = float(np.mean(pred.argmax(1) == y))
+        assert acc > 0.8
+
+
+class TestRanking:
+    def test_lambdarank(self):
+        X, y, group = make_ranking()
+        dtrain = lgb.Dataset(X, label=y, group=group)
+        evals = {}
+        bst = lgb.train({"objective": "lambdarank", "metric": "ndcg",
+                         "eval_at": [5], "verbosity": -1, "num_leaves": 15,
+                         "min_data_in_leaf": 5},
+                        dtrain, 30, valid_sets=[dtrain],
+                        valid_names=["train"],
+                        callbacks=[record_evaluation(evals)])
+        ndcg = evals["train"]["ndcg@5"]
+        assert ndcg[-1] > ndcg[0]
+        assert ndcg[-1] > 0.75
+
+    def test_rank_xendcg(self):
+        X, y, group = make_ranking()
+        dtrain = lgb.Dataset(X, label=y, group=group)
+        bst = lgb.train({"objective": "rank_xendcg", "verbosity": -1,
+                         "num_leaves": 15, "min_data_in_leaf": 5,
+                         "metric": "ndcg", "eval_at": [5]}, dtrain, 30,
+                        valid_sets=[dtrain], valid_names=["train"])
+        assert bst.best_score["train"]["ndcg@5"] > 0.7
+
+
+class TestBoostingModes:
+    def test_goss(self):
+        X, y = make_binary(n=4000)
+        bst = lgb.train({"objective": "binary", "boosting": "goss",
+                         "top_rate": 0.2, "other_rate": 0.1,
+                         "verbosity": -1}, lgb.Dataset(X, label=y), 30)
+        assert _auc(bst.predict(X), y) > 0.93
+
+    def test_dart(self):
+        X, y = make_binary()
+        bst = lgb.train({"objective": "binary", "boosting": "dart",
+                         "drop_rate": 0.3, "verbosity": -1},
+                        lgb.Dataset(X, label=y), 25)
+        assert _auc(bst.predict(X), y) > 0.9
+
+    def test_rf(self):
+        X, y = make_binary(n=4000)
+        bst = lgb.train({"objective": "binary", "boosting": "rf",
+                         "bagging_freq": 1, "bagging_fraction": 0.7,
+                         "verbosity": -1},
+                        lgb.Dataset(X, label=y), 20)
+        # averaged forest: prediction in probability space after sigmoid
+        assert _auc(bst.predict(X), y) > 0.9
+
+    def test_bagging(self):
+        X, y = make_binary()
+        bst = lgb.train({"objective": "binary", "bagging_freq": 2,
+                         "bagging_fraction": 0.6, "bagging_seed": 7,
+                         "verbosity": -1}, lgb.Dataset(X, label=y), 25)
+        assert _auc(bst.predict(X), y) > 0.93
+
+    def test_feature_fraction(self):
+        X, y = make_binary()
+        bst = lgb.train({"objective": "binary", "feature_fraction": 0.5,
+                         "verbosity": -1}, lgb.Dataset(X, label=y), 25)
+        assert _auc(bst.predict(X), y) > 0.9
+
+
+class TestRegularization:
+    @pytest.mark.parametrize("param,value", [
+        ("lambda_l1", 5.0), ("lambda_l2", 50.0), ("max_delta_step", 0.1),
+        ("min_gain_to_split", 1.0), ("path_smooth", 10.0)])
+    def test_regularizers_run(self, param, value):
+        X, y = make_binary()
+        bst = lgb.train({"objective": "binary", param: value,
+                         "verbosity": -1}, lgb.Dataset(X, label=y), 10)
+        assert _auc(bst.predict(X), y) > 0.8
+
+    def test_min_data_in_leaf(self):
+        X, y = make_binary()
+        bst = lgb.train({"objective": "binary", "min_data_in_leaf": 200,
+                         "verbosity": -1}, lgb.Dataset(X, label=y), 10)
+        model = bst._host_model()
+        for t in model.trees:
+            assert t.leaf_count.min() >= 200
+
+    def test_max_depth(self):
+        X, y = make_binary()
+        bst = lgb.train({"objective": "binary", "max_depth": 3,
+                         "num_leaves": 100, "verbosity": -1},
+                        lgb.Dataset(X, label=y), 5)
+        # depth-3 tree has at most 8 leaves
+        for t in bst._host_model().trees:
+            assert t.num_leaves <= 8
+
+    def test_num_leaves(self):
+        X, y = make_binary()
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbosity": -1}, lgb.Dataset(X, label=y), 5)
+        for t in bst._host_model().trees:
+            assert 1 < t.num_leaves <= 7
+
+
+class TestCallbacks:
+    def test_early_stopping(self):
+        X, y = make_binary(n=3000)
+        dtrain = lgb.Dataset(X[:2000], label=y[:2000])
+        dvalid = lgb.Dataset(X[2000:], label=y[2000:], reference=dtrain)
+        bst = lgb.train({"objective": "binary", "metric": "binary_logloss",
+                         "verbosity": -1, "learning_rate": 0.3},
+                        dtrain, 500, valid_sets=[dvalid],
+                        callbacks=[early_stopping(10, verbose=False)])
+        assert bst.best_iteration < 500
+        assert bst.current_iteration() >= bst.best_iteration
+
+    def test_record_evaluation(self):
+        X, y = make_binary()
+        dtrain = lgb.Dataset(X, label=y)
+        evals = {}
+        lgb.train({"objective": "binary", "metric": "auc", "verbosity": -1},
+                  dtrain, 10, valid_sets=[dtrain], valid_names=["train"],
+                  callbacks=[record_evaluation(evals)])
+        assert len(evals["train"]["auc"]) == 10
+
+    def test_reset_parameter(self):
+        X, y = make_binary()
+        dtrain = lgb.Dataset(X, label=y)
+        lrs = []
+
+        def spy(env):
+            lrs.append(env.model.gbdt.shrinkage_rate)
+        spy.order = 50
+        lgb.train({"objective": "binary", "verbosity": -1}, dtrain, 6,
+                  callbacks=[reset_parameter(
+                      learning_rate=lambda i: 0.1 * (0.5 ** i)), spy])
+        assert lrs[0] == pytest.approx(0.1)
+        assert lrs[-1] == pytest.approx(0.1 * 0.5 ** 5)
+
+
+class TestCustomObjective:
+    def test_fobj_feval(self):
+        X, y = make_binary()
+        dtrain = lgb.Dataset(X, label=y)
+
+        def logloss_obj(score, data):
+            p = 1.0 / (1.0 + np.exp(-score))
+            lbl = data.get_label()
+            return p - lbl, p * (1 - p)
+
+        def my_metric(score, data):
+            p = 1.0 / (1.0 + np.exp(-score))
+            return ("my_auc", _auc(p, data.get_label()), True)
+
+        evals = {}
+        lgb.train({"verbosity": -1}, dtrain, 15, fobj=logloss_obj,
+                  feval=my_metric, valid_sets=[dtrain],
+                  valid_names=["train"],
+                  callbacks=[record_evaluation(evals)])
+        assert evals["train"]["my_auc"][-1] > 0.9
+
+
+class TestCV:
+    def test_cv_returns_means(self):
+        X, y = make_binary()
+        dtrain = lgb.Dataset(X, label=y)
+        res = lgb.cv({"objective": "binary", "metric": "auc",
+                      "verbosity": -1}, dtrain, 10, nfold=3)
+        assert "valid auc-mean" in res
+        assert len(res["valid auc-mean"]) == 10
+        assert res["valid auc-mean"][-1] > 0.9
+
+
+class TestModelIO:
+    def test_roundtrip_exact(self, tmp_path):
+        X, y = make_binary()
+        bst = lgb.train({"objective": "binary", "verbosity": -1},
+                        lgb.Dataset(X, label=y), 10)
+        path = str(tmp_path / "model.txt")
+        bst.save_model(path)
+        bst2 = lgb.Booster(model_file=path)
+        np.testing.assert_allclose(bst.predict(X), bst2.predict(X),
+                                   rtol=1e-10)
+
+    def test_dump_json(self):
+        X, y = make_binary()
+        bst = lgb.train({"objective": "binary", "verbosity": -1},
+                        lgb.Dataset(X, label=y), 3)
+        d = bst.dump_model()
+        assert d["num_class"] == 1
+        assert len(d["tree_info"]) == 3
+        assert "tree_structure" in d["tree_info"][0]
+
+    def test_feature_importance(self):
+        X, y = make_binary()
+        bst = lgb.train({"objective": "binary", "verbosity": -1},
+                        lgb.Dataset(X, label=y), 10)
+        imp_split = bst.feature_importance("split")
+        imp_gain = bst.feature_importance("gain")
+        assert imp_split.sum() > 0
+        # informative features should dominate
+        assert imp_gain[:3].sum() > imp_gain[3:].sum()
+
+    def test_pred_leaf_and_contrib(self):
+        X, y = make_binary(n=300)
+        bst = lgb.train({"objective": "binary", "verbosity": -1},
+                        lgb.Dataset(X, label=y), 5)
+        leaves = bst.predict(X[:10], pred_leaf=True)
+        assert leaves.shape == (10, 5)
+        contrib = bst.predict(X[:10], pred_contrib=True)
+        assert contrib.shape == (10, X.shape[1] + 1)
+        raw = bst.predict(X[:10], raw_score=True)
+        np.testing.assert_allclose(contrib.sum(1), raw, rtol=1e-4)
+
+    def test_refit(self):
+        X, y = make_binary()
+        bst = lgb.train({"objective": "binary", "verbosity": -1},
+                        lgb.Dataset(X, label=y), 5)
+        X2, y2 = make_binary(seed=7)
+        bst2 = bst.refit(X2, y2)
+        assert _auc(bst2.predict(X2), y2) > 0.7
+
+
+class TestMissingValues:
+    def test_nan_handling(self):
+        X, y = make_binary()
+        Xm = X.copy()
+        mask = np.random.RandomState(3).rand(*X.shape) < 0.2
+        Xm[mask] = np.nan
+        bst = lgb.train({"objective": "binary", "verbosity": -1},
+                        lgb.Dataset(Xm, label=y), 20)
+        pred = bst.predict(Xm)
+        assert np.all(np.isfinite(pred))
+        assert _auc(pred, y) > 0.85
+
+
+class TestCategorical:
+    def test_categorical_feature(self):
+        r = np.random.RandomState(0)
+        n = 3000
+        cat = r.randint(0, 8, n).astype(np.float64)
+        X = np.column_stack([cat, r.randn(n)])
+        effect = np.array([2.0, -1.0, 0.5, 3.0, -2.0, 0.0, 1.0, -0.5])
+        y = (effect[cat.astype(int)] + 0.3 * r.randn(n) > 0.5) \
+            .astype(np.float32)
+        bst = lgb.train({"objective": "binary", "verbosity": -1,
+                         "min_data_in_leaf": 5},
+                        lgb.Dataset(X, label=y,
+                                    categorical_feature=[0]), 30)
+        assert _auc(bst.predict(X), y) > 0.9
+        # categorical split must appear in the model text
+        assert "num_cat=1" in bst.model_to_string() or \
+               any(t.num_cat > 0 for t in bst._host_model().trees)
