@@ -1,0 +1,81 @@
+"""Benchmark: Higgs-style 1M x 28 binary classification, 255 leaves.
+
+Mirrors the reference's headline benchmark (docs/Experiments.rst:111-123:
+Higgs 500 trees, num_leaves=255, 28-core Xeon -> 130.094 s total,
+i.e. 3.843 trees/sec). No dataset download is possible here, so a synthetic
+Higgs-shaped problem (1M rows x 28 continuous features, balanced binary
+labels from a nonlinear rule) stands in; the metric is trees/sec of the
+steady-state training loop on the visible accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+N_FEATURES = 28
+NUM_LEAVES = 255
+MAX_BIN = 255
+WARMUP_TREES = 5
+BENCH_TREES = int(os.environ.get("BENCH_TREES", 30))
+BASELINE_TREES_PER_SEC = 500.0 / 130.094  # reference CPU Higgs headline
+
+
+def make_higgs_like(n, f, seed=17):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    # nonlinear separation rule on a few "physics" features + noise dims
+    logit = (1.2 * X[:, 0] - 0.8 * X[:, 1] + 0.6 * X[:, 2] * X[:, 3] +
+             0.5 * np.abs(X[:, 4]) - 0.4 * X[:, 5] ** 2 +
+             0.3 * X[:, 6] * X[:, 0] + 0.35 * rng.randn(n))
+    y = (logit > np.median(logit)).astype(np.float32)
+    return X, y
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import lightgbm_tpu as lgb
+
+    X, y = make_higgs_like(N_ROWS, N_FEATURES)
+    t0 = time.time()
+    dtrain = lgb.Dataset(X, label=y, params={"max_bin": MAX_BIN})
+    dtrain.construct()
+    bin_time = time.time() - t0
+
+    params = {"objective": "binary", "num_leaves": NUM_LEAVES,
+              "learning_rate": 0.1, "max_bin": MAX_BIN, "verbosity": -1,
+              "min_data_in_leaf": 20}
+    booster = lgb.Booster(params=params, train_set=dtrain)
+
+    # warmup: compile all jitted phases
+    for _ in range(WARMUP_TREES):
+        booster.update()
+    import jax
+    jax.block_until_ready(booster.gbdt.train_score)
+
+    t1 = time.time()
+    for _ in range(BENCH_TREES):
+        booster.update()
+    jax.block_until_ready(booster.gbdt.train_score)
+    dt = time.time() - t1
+
+    trees_per_sec = BENCH_TREES / dt
+    result = {
+        "metric": "higgs1m_trees_per_sec",
+        "value": round(trees_per_sec, 3),
+        "unit": "trees/sec",
+        "vs_baseline": round(trees_per_sec / BASELINE_TREES_PER_SEC, 3),
+    }
+    print(json.dumps(result))
+    print(f"# bench detail: {BENCH_TREES} trees in {dt:.2f}s "
+          f"({dt / BENCH_TREES * 1000:.1f} ms/tree), binning {bin_time:.1f}s, "
+          f"device={jax.devices()[0].device_kind}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -70,8 +70,7 @@ class DART(GBDT):
     def _apply_tree_to_scores(self, it: int, cls: int, factor: float) -> None:
         k = self.num_tree_per_iteration
         tree = self.trees[it * k + cls]
-        vals = predict_binned_tree(tree, self.bins, self.num_bins_d,
-                                   self.missing_is_nan_d) * factor
+        vals = self._predict_train_rows(tree) * factor
         if k == 1:
             self.train_score = self.train_score + vals
         else:
@@ -113,9 +112,7 @@ class DART(GBDT):
             tree = self.trees[idx]
             if new_factor != 1.0:
                 # remove over-counted part from scores
-                vals = predict_binned_tree(
-                    tree, self.bins, self.num_bins_d,
-                    self.missing_is_nan_d) * (new_factor - 1.0)
+                vals = self._predict_train_rows(tree) * (new_factor - 1.0)
                 cls_id = self.tree_class[idx]
                 if k == 1:
                     self.train_score = self.train_score + vals

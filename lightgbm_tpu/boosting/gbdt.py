@@ -93,10 +93,74 @@ class GBDT:
             max_cat_threshold=cfg.max_cat_threshold,
             max_cat_to_onehot=cfg.max_cat_to_onehot,
             min_data_per_group=cfg.min_data_per_group)
+        self._setup_parallel(cfg)
         self._bag_mask = jnp.ones(self.num_data, jnp.float32)
         self._boosted_from_average = [False] * k
         if self.objective is not None:
             self.objective.init(ds.metadata, ds.num_data)
+
+    def _setup_parallel(self, cfg) -> None:
+        """Distributed learner setup (reference CreateTreeLearner crossbar,
+        tree_learner.cpp:16-64, + Network::Init)."""
+        self.comm = None
+        self.mesh = None
+        self._grower = None
+        self._row_pad = 0
+        if cfg.tree_learner == "serial":
+            return
+        ndev = cfg.num_devices if cfg.num_devices > 0 else len(jax.devices())
+        ndev = min(ndev, len(jax.devices()))
+        if ndev <= 1:
+            Log.warning("tree_learner=%s requested but only one device "
+                        "visible; falling back to serial", cfg.tree_learner)
+            return
+        from ..parallel import CommSpec, make_mesh
+        from ..parallel.learner import make_sharded_grower
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        self.mesh = make_mesh(ndev)
+        self.comm = CommSpec(axis="data", mode=cfg.tree_learner,
+                             num_devices=ndev, top_k=cfg.top_k)
+        if self.comm.mode in ("data", "voting"):
+            self._row_pad = (-self.num_data) % ndev
+            if self._row_pad:
+                self.bins = jnp.pad(self.bins,
+                                    ((0, self._row_pad), (0, 0)))
+            self.bins = jax.device_put(
+                self.bins, NamedSharding(self.mesh, P("data")))
+        else:  # feature-parallel replicates rows (docs/Features.rst:109)
+            self.bins = jax.device_put(
+                self.bins, NamedSharding(self.mesh, P()))
+        self._grower = make_sharded_grower(
+            self.mesh, self.comm, num_leaves=cfg.num_leaves,
+            max_depth=cfg.max_depth, hp=self.hp, leafwise=False,
+            bmax=self.bmax)
+        Log.info("Distributed learner: %s-parallel over %d devices",
+                 self.comm.mode, ndev)
+
+    def _grow(self, g, h, cnt, feature_mask):
+        """Dispatch serial vs sharded growth; returns (tree, row_node[:N])."""
+        if self._grower is None:
+            return grow_tree(
+                self.bins, g, h, cnt, feature_mask, self.num_bins_d,
+                self.missing_is_nan_d, self.is_cat_d,
+                num_leaves=self.config.num_leaves,
+                max_depth=self.config.max_depth, hp=self.hp,
+                leafwise=False, bmax=self.bmax)
+        if self._row_pad:
+            g = jnp.pad(g, (0, self._row_pad))
+            h = jnp.pad(h, (0, self._row_pad))
+            cnt = jnp.pad(cnt, (0, self._row_pad))
+        with self.mesh:
+            tree, row_node = self._grower(
+                self.bins, g, h, cnt, feature_mask, self.num_bins_d,
+                self.missing_is_nan_d, self.is_cat_d)
+        return tree, row_node[:self.num_data]
+
+    def _predict_train_rows(self, tree: TreeArrays) -> jax.Array:
+        """Tree outputs for the (unpadded) training rows."""
+        vals = predict_binned_tree(tree, self.bins, self.num_bins_d,
+                                   self.missing_is_nan_d)
+        return vals[:self.num_data] if self._row_pad else vals
 
     def add_valid(self, ds: BinnedDataset, name: str,
                   metrics: List[Metric]) -> None:
@@ -206,11 +270,7 @@ class GBDT:
             h = hess if k == 1 else hess[:, cls]
             with global_timer.timeit("tree_train"):
                 feature_mask = self._feature_mask()
-                tree, row_node = grow_tree(
-                    self.bins, g, h, cnt, feature_mask,
-                    self.num_bins_d, self.missing_is_nan_d, self.is_cat_d,
-                    num_leaves=cfg.num_leaves, max_depth=cfg.max_depth,
-                    hp=self.hp, leafwise=False, bmax=self.bmax)
+                tree, row_node = self._grow(g, h, cnt, feature_mask)
             nleaves = int(tree.num_leaves)
             if nleaves > 1:
                 should_continue = True
@@ -331,8 +391,7 @@ class GBDT:
         for cls in range(k):
             tree = self.trees.pop()
             cls_id = self.tree_class.pop()
-            vals = predict_binned_tree(tree, self.bins, self.num_bins_d,
-                                       self.missing_is_nan_d)
+            vals = self._predict_train_rows(tree)
             if k == 1:
                 self.train_score = self.train_score - vals
             else:

@@ -25,12 +25,14 @@ matrix.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from ..parallel.comm import CommSpec
 from .histogram import build_histograms
 from .split import BestSplits, SplitHyperParams, find_best_splits, leaf_output
 
@@ -92,17 +94,33 @@ def _init_tree(max_nodes: int, root_grad, root_hess, root_count,
         num_leaves=jnp.asarray(1, jnp.int32))
 
 
+def _merge_gathered_best(gathered: BestSplits) -> BestSplits:
+    """Pick the max-gain split across devices per slot (the reference's
+    SyncUpGlobalBestSplit max-gain reducer, parallel_tree_learner.h:191-214).
+    gathered fields: [D, S]."""
+    win = jnp.argmax(gathered.gain, axis=0)                   # [S]
+
+    def pick(name, field):
+        if name == "per_feature_gain":  # disjoint shards: elementwise max
+            return jnp.max(field, axis=0)
+        return jnp.take_along_axis(field, win[None], axis=0)[0]
+
+    return BestSplits(*[pick(f, getattr(gathered, f))
+                        for f in BestSplits._fields])
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("num_leaves", "max_depth", "hp", "leafwise", "bmax",
-                     "feature_block", "max_passes"))
+                     "feature_block", "max_passes", "comm"))
 def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               cnt_weight: jax.Array, feature_mask: jax.Array,
               num_bins: jax.Array, missing_is_nan: jax.Array,
               is_cat_feat: jax.Array, *, num_leaves: int, max_depth: int,
               hp: SplitHyperParams, leafwise: bool = False, bmax: int,
-              feature_block: int = 8,
-              max_passes: int = 0) -> Tuple[TreeArrays, jax.Array]:
+              feature_block: int = 8, max_passes: int = 0,
+              comm: Optional[CommSpec] = None
+              ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree. grad/hess must already include bagging/objective
     weights (zeros for out-of-bag rows); `cnt_weight` is 1.0 for in-bag rows
     and 0.0 otherwise so min_data_in_leaf counts sampled rows only.
@@ -117,10 +135,24 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     if max_passes <= 0:
         max_passes = num_leaves - 1
     k_top = num_leaves - 1             # static top-k size
+    rows_sharded = comm is not None and comm.mode in ("data", "voting")
+    if comm is not None and comm.mode == "feature":
+        # deterministic round-robin feature shard (the reference balances by
+        # total bin count, feature_parallel_tree_learner.cpp:38-57; round
+        # robin gives the same expected balance for quantized features)
+        my = jax.lax.axis_index(comm.axis)
+        feature_mask = feature_mask * (
+            (jnp.arange(f, dtype=jnp.int32) % comm.num_devices) == my
+        ).astype(feature_mask.dtype)
 
     root_g = jnp.sum(grad)
     root_h = jnp.sum(hess)
     root_c = jnp.sum(cnt_weight)
+    if rows_sharded:
+        # root grad/hess sums allreduced (data_parallel_tree_learner.cpp:126)
+        root_g = jax.lax.psum(root_g, comm.axis)
+        root_h = jax.lax.psum(root_h, comm.axis)
+        root_c = jax.lax.psum(root_c, comm.axis)
     root_val = leaf_output(root_g, root_h, hp.lambda_l1, hp.lambda_l2,
                            hp.max_delta_step)
     tree = _init_tree(m, root_g, root_h, root_c, root_val)
@@ -134,7 +166,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         left_hess=jnp.zeros(m + 1, jnp.float32),
         left_count=jnp.zeros(m + 1, jnp.float32),
         left_output=jnp.zeros(m + 1, jnp.float32),
-        right_output=jnp.zeros(m + 1, jnp.float32))
+        right_output=jnp.zeros(m + 1, jnp.float32),
+        per_feature_gain=jnp.zeros((1, 1), jnp.float32))
 
     state = _GrowState(
         tree=tree,
@@ -154,15 +187,63 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         row_slot = st.slot_of_node[st.row_node]            # [N]
         hist = build_histograms(bins, grad, hess, row_slot, num_slots=s,
                                 bmax=bmax, feature_block=feature_block)
-        # ---- 2. best-split scan per slot ----
+        # ---- 2. best-split scan per slot (with collectives if parallel) ----
         sn = st.slot_nodes                                  # [S] (M=dummy)
-        bs = find_best_splits(
-            hist, tree.sum_grad[sn], tree.sum_hess[sn], tree.count[sn],
-            tree.leaf_value[sn], num_bins, missing_is_nan, is_cat_feat,
-            feature_mask, hp)
+
+        def scan_hist(h, fm):
+            return find_best_splits(
+                h, tree.sum_grad[sn], tree.sum_hess[sn], tree.count[sn],
+                tree.leaf_value[sn], num_bins, missing_is_nan, is_cat_feat,
+                fm, hp)
+
+        if comm is None:
+            bs = scan_hist(hist, feature_mask)
+        elif comm.mode == "data":
+            # histogram merge == the ReduceScatter of
+            # data_parallel_tree_learner.cpp:184-186; psum lets every device
+            # scan all features (no best-split sync round needed after)
+            bs = scan_hist(jax.lax.psum(hist, comm.axis), feature_mask)
+        elif comm.mode == "feature":
+            # local scan over this device's feature shard, then global
+            # max-gain sync (feature_parallel_tree_learner.cpp:58-84)
+            local = scan_hist(hist, feature_mask)
+            gathered = BestSplits(*[
+                jax.lax.all_gather(getattr(local, fld), comm.axis)
+                for fld in BestSplits._fields])
+            bs = _merge_gathered_best(gathered)
+        else:  # voting (PV-Tree, voting_parallel_tree_learner.cpp)
+            # local scan with constraints scaled down by num_machines
+            # (voting_parallel_tree_learner.cpp:62-63)
+            hp_local = dataclasses.replace(
+                hp,
+                min_data_in_leaf=max(1, hp.min_data_in_leaf //
+                                     comm.num_devices),
+                min_sum_hessian_in_leaf=hp.min_sum_hessian_in_leaf /
+                comm.num_devices)
+            local = find_best_splits(
+                hist, tree.sum_grad[sn] / comm.num_devices,
+                tree.sum_hess[sn] / comm.num_devices,
+                tree.count[sn] / comm.num_devices,
+                tree.leaf_value[sn], num_bins, missing_is_nan, is_cat_feat,
+                feature_mask, hp_local)
+            k_vote = min(comm.top_k, f)
+            _, vote_idx = jax.lax.top_k(local.per_feature_gain, k_vote)
+            votes = jnp.zeros((s, f), jnp.float32)
+            votes = jax.vmap(lambda v, i: v.at[i].add(1.0))(votes, vote_idx)
+            gvotes = jax.lax.psum(votes, comm.axis)
+            # global top-2k selection per slot; aggregate only those columns
+            k_sel = min(2 * comm.top_k, f)
+            _, sel_idx = jax.lax.top_k(gvotes, k_sel)
+            sel_mask = jnp.zeros((s, f), jnp.float32)
+            sel_mask = jax.vmap(
+                lambda v, i: v.at[i].set(1.0))(sel_mask, sel_idx)
+            hist_sel = hist * sel_mask[:, :, None, None]
+            ghist = jax.lax.psum(hist_sel, comm.axis)
+            bs = scan_hist(ghist, sel_mask * feature_mask[None, :])
         # scatter slot results into per-node best arrays (dummy -> row m)
         best = BestSplits(*[
             getattr(st.best, fld).at[sn].set(getattr(bs, fld))
+            if fld != "per_feature_gain" else st.best.per_feature_gain
             for fld in BestSplits._fields])
         # ---- 3. choose splits: top-budget by gain ----
         eligible = tree.is_leaf & jnp.isfinite(best.gain) & (best.gain > 0)

@@ -124,6 +124,10 @@ def find_best_splits(hist: jax.Array, parent_grad: jax.Array,
     s, f, b, _ = hist.shape
     l1, l2 = hp.lambda_l1, hp.lambda_l2
     bins_r = jnp.arange(b, dtype=jnp.int32)
+    # normalize feature_mask to [S, F]
+    fmask = jnp.broadcast_to(
+        feature_mask.astype(jnp.float32).reshape(
+            (1, f) if feature_mask.ndim == 1 else (s, f)), (s, f))
 
     tot = jnp.stack([parent_grad, parent_hess, parent_count], -1)  # [S, 3]
     tot = tot[:, None, None, :]                                    # [S,1,1,3]
@@ -144,8 +148,9 @@ def find_best_splits(hist: jax.Array, parent_grad: jax.Array,
 
     # threshold t valid iff t <= num_bins-2 (-1 more when NaN bin present)
     t_limit = num_bins - 2 - missing_is_nan.astype(jnp.int32)      # [F]
-    valid_t = bins_r[None, :] <= t_limit[:, None]                  # [F, B]
-    valid_t &= (~is_cat[:, None]) & (feature_mask[:, None] > 0)
+    valid_t = bins_r[None, None, :] <= t_limit[None, :, None]      # [1,F,B]
+    valid_t = valid_t & (~is_cat[None, :, None]) & \
+        (fmask[:, :, None] > 0)                                    # [S,F,B]
 
     def eval_option(left):                                         # [S,F,B,3]
         right = tot - left
@@ -156,7 +161,7 @@ def find_best_splits(hist: jax.Array, parent_grad: jax.Array,
               (rh >= hp.min_sum_hessian_in_leaf))
         g = _split_gain(lg, lh, lc, rg, rh, rc, l1, l2, hp,
                         parent_output[:, None, None])
-        return jnp.where(ok & valid_t[None], g, -jnp.inf)
+        return jnp.where(ok & valid_t, g, -jnp.inf)
 
     gain_na_right = eval_option(prefix)                       # NaN stays right
     gain_na_left = jnp.where(
@@ -167,7 +172,7 @@ def find_best_splits(hist: jax.Array, parent_grad: jax.Array,
     # left = single category bin ("bin == t" decision); NaN/unseen (bin 0)
     # always right. cat_l2/cat_smooth regularization per
     # feature_histogram.hpp:508-560 (one-hot branch).
-    cat_valid = is_cat[None, :, None] & (feature_mask[None, :, None] > 0) & \
+    cat_valid = is_cat[None, :, None] & (fmask[:, :, None] > 0) & \
         (bins_r[None, None, :] >= 1) & \
         (bins_r[None, None, :] <= (num_bins[None, :, None] - 1))
     cl2 = l2 + hp.cat_l2
@@ -219,10 +224,16 @@ def find_best_splits(hist: jax.Array, parent_grad: jax.Array,
                        hp.path_smooth, rcs, parent_output)
     shift = jnp.where(best_is_cat, cat_gain_shift, gain_shift)
 
+    # per-feature best gain (minus the feature's gain shift) for voting
+    pf_shift = jnp.where(is_cat[None, :], cat_gain_shift[:, None],
+                         gain_shift[:, None])                      # [S, F]
+    per_feature_gain = jnp.max(all_gain, axis=2) - pf_shift        # [S, F]
+
     return BestSplits(
         gain=jnp.where(has_split, best_gain - shift, -jnp.inf),
         feature=jnp.where(has_split, best_f, -1),
         threshold_bin=best_t,
         default_left=jnp.where(best_is_cat, False, chose_na_left),
         left_grad=lgs, left_hess=lhs, left_count=lcs,
-        left_output=lout, right_output=rout)
+        left_output=lout, right_output=rout,
+        per_feature_gain=per_feature_gain)

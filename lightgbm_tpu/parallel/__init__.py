@@ -1,0 +1,4 @@
+from .comm import CommSpec
+from .mesh import default_mesh, make_mesh
+
+__all__ = ["CommSpec", "make_mesh", "default_mesh"]

@@ -1,0 +1,37 @@
+"""Communication spec for distributed tree learning.
+
+The reference's whole network layer (src/network/: Bruck allgather,
+recursive-halving reduce-scatter, socket/MPI linkers — SURVEY.md §2.4)
+collapses to THREE collective call sites expressed with jax.lax ops inside
+`shard_map`; XLA picks the wire algorithms (ICI/DCN routing, ring vs
+recursive) that src/network/network.cpp:68-301 hand-implements:
+
+- data-parallel  (data_parallel_tree_learner.cpp): histogram merge
+  = `psum` / `psum_scatter` over the row-sharded mesh axis.
+- feature-parallel (feature_parallel_tree_learner.cpp): best-split sync
+  = `all_gather` of per-device SplitInfo + argmax (the max-gain reducer of
+  parallel_tree_learner.h:191-214).
+- voting-parallel (voting_parallel_tree_learner.cpp, PV-Tree): local top-k
+  votes -> `psum` of vote one-hots -> top-2k feature selection -> masked
+  histogram `psum`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+__all__ = ["CommSpec"]
+
+
+@dataclasses.dataclass(frozen=True)
+class CommSpec:
+    """Static distributed-training configuration (hashable for jit)."""
+    axis: str = "data"            # mesh axis name
+    mode: str = "data"            # "data" | "feature" | "voting"
+    num_devices: int = 1
+    top_k: int = 20               # voting-parallel top-k (config.top_k)
+
+    def __post_init__(self):
+        if self.mode not in ("data", "feature", "voting"):
+            raise ValueError(f"unknown parallel mode {self.mode!r}")

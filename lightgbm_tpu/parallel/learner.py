@@ -1,0 +1,71 @@
+"""Sharded tree learner: shard_map'ped growth over a device mesh.
+
+The factory role of the reference's CreateTreeLearner crossbar
+(tree_learner.cpp:16-64: device x {serial,feature,data,voting}) — here the
+"device" dimension is always TPU/XLA and the parallelism dimension picks the
+collective pattern (CommSpec). Parallel learners in the reference are
+templates OVER the serial learner (parallel_tree_learner.h:26-107); here the
+same single `grow_tree` body runs inside `shard_map`, with its collectives
+activated by `comm`.
+
+Sharding contract (1-D mesh, axis "data"):
+- data/voting: bins/grad/hess/cnt row-sharded; tree replicated out.
+- feature: bins replicated (the reference feature-parallel replicates data,
+  docs/Features.rst:109); the per-device feature shard is derived from
+  axis_index inside the grower.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..learner.grower import grow_tree
+from .comm import CommSpec
+
+__all__ = ["make_sharded_grower", "shard_rows", "replicate"]
+
+
+def shard_rows(mesh: Mesh, *arrays):
+    """Place arrays with rows sharded over the mesh axis."""
+    axis = mesh.axis_names[0]
+    out = []
+    for a in arrays:
+        spec = P(axis) if a.ndim >= 1 else P()
+        out.append(jax.device_put(a, NamedSharding(mesh, spec)))
+    return out if len(out) > 1 else out[0]
+
+
+def replicate(mesh: Mesh, *arrays):
+    out = [jax.device_put(a, NamedSharding(mesh, P())) for a in arrays]
+    return out if len(out) > 1 else out[0]
+
+
+def make_sharded_grower(mesh: Mesh, comm: CommSpec, *, num_leaves: int,
+                        max_depth: int, hp, leafwise: bool, bmax: int,
+                        feature_block: int = 8):
+    """Build a shard_map'ped grow_tree with the given static config."""
+    axis = comm.axis
+    data_spec = P(axis) if comm.mode in ("data", "voting") else P()
+
+    grower = functools.partial(
+        grow_tree, num_leaves=num_leaves, max_depth=max_depth, hp=hp,
+        leafwise=leafwise, bmax=bmax, feature_block=feature_block,
+        comm=comm)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(data_spec, data_spec, data_spec, data_spec,
+                  P(), P(), P(), P()),
+        out_specs=(P(), data_spec),
+        check_vma=False)
+    def sharded(bins, grad, hess, cnt, feature_mask, num_bins,
+                missing_is_nan, is_cat):
+        return grower(bins, grad, hess, cnt, feature_mask, num_bins,
+                      missing_is_nan, is_cat)
+
+    return jax.jit(sharded)

@@ -1,0 +1,42 @@
+"""Device mesh helpers.
+
+The reference initializes a process-global Network singleton from a machine
+list (network.cpp:17-30, linkers_socket.cpp). The TPU equivalent is a
+`jax.sharding.Mesh` over the visible devices; multi-host pods join via
+`jax.distributed.initialize` (DCN) before constructing the mesh — the
+moral analog of the reference's `Network::Init`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "default_mesh", "init_distributed"]
+
+
+def make_mesh(num_devices: int = 0, axis: str = "data") -> Mesh:
+    devices = jax.devices()
+    if num_devices <= 0:
+        num_devices = len(devices)
+    if num_devices > len(devices):
+        raise ValueError(
+            f"requested {num_devices} devices, only {len(devices)} visible")
+    return Mesh(np.array(devices[:num_devices]), (axis,))
+
+
+def default_mesh(axis: str = "data") -> Mesh:
+    return make_mesh(0, axis)
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host initialization (reference Network::Init + machine list;
+    here jax.distributed handles rendezvous over DCN)."""
+    if coordinator_address is not None:
+        jax.distributed.initialize(coordinator_address, num_processes,
+                                   process_id)
