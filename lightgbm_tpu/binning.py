@@ -43,7 +43,13 @@ def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
                      max_bin: int, total_cnt: int,
                      min_data_in_bin: int) -> List[float]:
     """Pack distinct values into <= max_bin bins; returns bin upper bounds
-    (last bound is +inf). Mirrors src/io/bin.cpp:78 GreedyFindBin."""
+    (last bound is +inf). Mirrors src/io/bin.cpp:78 GreedyFindBin.
+    Dispatches to the native cext implementation when built."""
+    from . import cext
+    if cext.available() and len(distinct_values):
+        return cext.greedy_find_bin(
+            distinct_values, counts, max_bin, total_cnt,
+            min_data_in_bin).tolist()
     n = len(distinct_values)
     bounds: List[float] = []
     if n == 0:

@@ -82,6 +82,34 @@ class GBDT:
             self._has_init_score = True
         else:
             self._has_init_score = False
+        # monotone constraints (original-feature order -> used-feature order)
+        self._monotone = None
+        has_monotone = False
+        if cfg.monotone_constraints:
+            mc = np.zeros(ds.num_total_features, np.int32)
+            arr = np.asarray(cfg.monotone_constraints, np.int32)
+            mc[:len(arr)] = arr
+            used = np.asarray(ds.used_features, np.int64)
+            if np.any(mc[used] != 0):
+                self._monotone = jnp.asarray(mc[used])
+                has_monotone = True
+            if cfg.monotone_constraints_method != "basic":
+                Log.warning("monotone_constraints_method=%s approximated by "
+                            "'basic' on TPU",
+                            cfg.monotone_constraints_method)
+        # interaction constraints (groups of original feature indices)
+        self._interaction_groups = None
+        if cfg.interaction_constraints:
+            orig2used = {int(o): j
+                         for j, o in enumerate(ds.used_features)}
+            groups = []
+            for grp in cfg.interaction_constraints:
+                if not isinstance(grp, (list, tuple)):
+                    grp = [grp]
+                groups.append(tuple(sorted(
+                    orig2used[int(fi)] for fi in grp
+                    if int(fi) in orig2used)))
+            self._interaction_groups = tuple(g for g in groups if g)
         self.hp = SplitHyperParams(
             lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
             min_gain_to_split=cfg.min_gain_to_split,
@@ -92,8 +120,19 @@ class GBDT:
             cat_smooth=cfg.cat_smooth,
             max_cat_threshold=cfg.max_cat_threshold,
             max_cat_to_onehot=cfg.max_cat_to_onehot,
-            min_data_per_group=cfg.min_data_per_group)
+            min_data_per_group=cfg.min_data_per_group,
+            has_monotone=has_monotone,
+            monotone_penalty=cfg.monotone_penalty,
+            extra_trees=cfg.extra_trees)
         self._setup_parallel(cfg)
+        # Pallas MXU histogram kernel on TPU-like backends (serial learner;
+        # the sharded path keeps the portable scatter fallback for now)
+        backend = jax.default_backend()
+        self._hist_impl = "pallas" if (
+            cfg.use_pallas and self._grower is None and
+            backend not in ("cpu",)) else "scatter"
+        if self._hist_impl == "pallas":
+            Log.debug("Using Pallas histogram kernel (backend=%s)", backend)
         self._bag_mask = jnp.ones(self.num_data, jnp.float32)
         self._boosted_from_average = [False] * k
         if self.objective is not None:
@@ -139,13 +178,22 @@ class GBDT:
 
     def _grow(self, g, h, cnt, feature_mask):
         """Dispatch serial vs sharded growth; returns (tree, row_node[:N])."""
+        cfg = self.config
+        needs_rng = self.hp.extra_trees or cfg.feature_fraction_bynode < 1.0
+        rng_key = jax.random.fold_in(
+            jax.random.PRNGKey(cfg.extra_seed), self.iter_) \
+            if needs_rng else None
         if self._grower is None:
             return grow_tree(
                 self.bins, g, h, cnt, feature_mask, self.num_bins_d,
                 self.missing_is_nan_d, self.is_cat_d,
-                num_leaves=self.config.num_leaves,
-                max_depth=self.config.max_depth, hp=self.hp,
-                leafwise=False, bmax=self.bmax)
+                num_leaves=cfg.num_leaves,
+                max_depth=cfg.max_depth, hp=self.hp,
+                leafwise=False, bmax=self.bmax,
+                monotone=self._monotone,
+                interaction_groups=self._interaction_groups,
+                feature_fraction_bynode=cfg.feature_fraction_bynode,
+                rng_key=rng_key, hist_impl=self._hist_impl)
         if self._row_pad:
             g = jnp.pad(g, (0, self._row_pad))
             h = jnp.pad(h, (0, self._row_pad))

@@ -1,0 +1,143 @@
+"""ctypes bridge to the native host runtime (lightgbm_tpu/cext/binning.cpp).
+
+Reference analog: the C++ data layer (DatasetLoader/Parser/BinMapper hot
+paths). The library builds lazily on first import with the system compiler
+(g++ -O3 -shared); everything degrades gracefully to the NumPy
+implementations when a compiler is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "binning.cpp")
+_LIB_PATH = os.path.join(_DIR, "libbinning.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
+             "-o", _LIB_PATH],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB_PATH) or \
+            os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC):
+        if not _build():
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    c_dp = ctypes.POINTER(ctypes.c_double)
+    c_ip = ctypes.POINTER(ctypes.c_int)
+    lib.lgbt_greedy_find_bin.restype = ctypes.c_int
+    lib.lgbt_greedy_find_bin.argtypes = [
+        c_dp, c_ip, ctypes.c_int, ctypes.c_int, ctypes.c_long,
+        ctypes.c_int, c_dp]
+    lib.lgbt_distinct.restype = ctypes.c_int
+    lib.lgbt_distinct.argtypes = [c_dp, ctypes.c_int, c_dp, c_ip]
+    lib.lgbt_parse_delimited.restype = ctypes.c_long
+    lib.lgbt_parse_delimited.argtypes = [
+        ctypes.c_char_p, ctypes.c_char, ctypes.c_int, c_dp, ctypes.c_long,
+        ctypes.c_int, c_ip]
+    lib.lgbt_count_rows.restype = ctypes.c_long
+    lib.lgbt_count_rows.argtypes = [ctypes.c_char_p, ctypes.c_char, c_ip]
+    lib.lgbt_values_to_bins.restype = None
+    lib.lgbt_values_to_bins.argtypes = [
+        c_dp, ctypes.c_long, c_dp, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8)]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def greedy_find_bin(distinct: np.ndarray, counts: np.ndarray, max_bin: int,
+                    total_cnt: int, min_data_in_bin: int) -> np.ndarray:
+    """Native GreedyFindBin; returns bin upper bounds (last = +inf)."""
+    lib = get_lib()
+    assert lib is not None
+    distinct = np.ascontiguousarray(distinct, np.float64)
+    counts = np.ascontiguousarray(counts, np.int32)
+    out = np.empty(max_bin + 2, np.float64)
+    n = lib.lgbt_greedy_find_bin(
+        distinct.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        len(distinct), max_bin, total_cnt, min_data_in_bin,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    return out[:n]
+
+
+def distinct_values(sorted_values: np.ndarray
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    lib = get_lib()
+    assert lib is not None
+    sorted_values = np.ascontiguousarray(sorted_values, np.float64)
+    vals = np.empty(len(sorted_values), np.float64)
+    cnts = np.empty(len(sorted_values), np.int32)
+    k = lib.lgbt_distinct(
+        sorted_values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        len(sorted_values),
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        cnts.ctypes.data_as(ctypes.POINTER(ctypes.c_int)))
+    return vals[:k], cnts[:k].astype(np.int64)
+
+
+def parse_delimited(path: str, delim: str = ",",
+                    skip_rows: int = 0) -> Optional[np.ndarray]:
+    """Native text parse to a dense [rows, cols] float64 matrix."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    cols = ctypes.c_int(0)
+    rows = lib.lgbt_count_rows(path.encode(), delim.encode(),
+                               ctypes.byref(cols))
+    if rows <= 0 or cols.value <= 0:
+        return None
+    rows -= skip_rows
+    out = np.zeros((rows, cols.value), np.float64)
+    got_cols = ctypes.c_int(0)
+    got = lib.lgbt_parse_delimited(
+        path.encode(), delim.encode(), skip_rows,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        rows, cols.value, ctypes.byref(got_cols))
+    if got < 0:
+        return None
+    return out[:got, :got_cols.value]
+
+
+def values_to_bins_u8(values: np.ndarray, bounds: np.ndarray,
+                      num_search: int, nan_bin: int) -> np.ndarray:
+    lib = get_lib()
+    assert lib is not None
+    values = np.ascontiguousarray(values, np.float64)
+    bounds = np.ascontiguousarray(bounds, np.float64)
+    out = np.empty(len(values), np.uint8)
+    lib.lgbt_values_to_bins(
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        len(values),
+        bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        num_search, nan_bin,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out

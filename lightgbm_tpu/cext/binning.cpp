@@ -1,0 +1,230 @@
+// Native host-side data layer: greedy bin finding + text parsing.
+//
+// TPU-native equivalent of the reference's C++ data-ingestion hot paths:
+// GreedyFindBin (src/io/bin.cpp:78), the CSV/TSV/LibSVM parsers
+// (src/io/parser.cpp) and the buffered TextReader (utils/text_reader.h).
+// The TPU compute path needs none of this on-device; these routines feed
+// the host-side quantization pipeline at C++ speed and are reached from
+// Python via ctypes (lightgbm_tpu/cext/__init__.py).
+//
+// Build: cc -O3 -shared -fPIC -fopenmp binning.cpp -o libbinning.so
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <limits>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Greedy bin finding over distinct values (behavior of bin.cpp:78-150):
+// values with counts >= mean bin size get dedicated bins; the rest are
+// packed greedily to equalize bin populations. Returns number of bounds
+// written to out_bounds (last is +inf).
+// ---------------------------------------------------------------------------
+int lgbt_greedy_find_bin(const double* distinct, const int* counts,
+                         int num_distinct, int max_bin, long total_cnt,
+                         int min_data_in_bin, double* out_bounds) {
+  int nb = 0;
+  if (num_distinct == 0) {
+    out_bounds[nb++] = std::numeric_limits<double>::infinity();
+    return nb;
+  }
+  auto check_eq = [](double a, double b) {
+    double tol = 1e-9 * std::max(std::fabs(a), std::fabs(b));
+    return a <= b + tol && a >= b - tol;
+  };
+  if (num_distinct <= max_bin) {
+    int cur = 0;
+    for (int i = 0; i < num_distinct - 1; ++i) {
+      cur += counts[i];
+      if (cur >= min_data_in_bin) {
+        double v = (distinct[i] + distinct[i + 1]) / 2.0;
+        if (nb == 0 || !check_eq(out_bounds[nb - 1], v)) {
+          out_bounds[nb++] = v;
+          cur = 0;
+        }
+      }
+    }
+    out_bounds[nb++] = std::numeric_limits<double>::infinity();
+    return nb;
+  }
+  if (min_data_in_bin > 0) {
+    long capped = std::min<long>(max_bin, total_cnt / min_data_in_bin);
+    max_bin = static_cast<int>(std::max<long>(1, capped));
+  }
+  double mean_size = static_cast<double>(total_cnt) / max_bin;
+  std::vector<char> is_big(num_distinct, 0);
+  int rest_bins = max_bin;
+  long rest_cnt = total_cnt;
+  for (int i = 0; i < num_distinct; ++i) {
+    if (counts[i] >= mean_size) {
+      is_big[i] = 1;
+      --rest_bins;
+      rest_cnt -= counts[i];
+    }
+  }
+  mean_size = static_cast<double>(rest_cnt) / std::max(rest_bins, 1);
+  std::vector<double> uppers, lowers;
+  lowers.push_back(distinct[0]);
+  int cur = 0;
+  for (int i = 0; i < num_distinct - 1; ++i) {
+    if (!is_big[i]) rest_cnt -= counts[i];
+    cur += counts[i];
+    if (is_big[i] || cur >= mean_size ||
+        (is_big[i + 1] && cur >= std::max(1.0, mean_size * 0.5))) {
+      uppers.push_back(distinct[i]);
+      lowers.push_back(distinct[i + 1]);
+      if (static_cast<int>(uppers.size()) >= max_bin - 1) break;
+      cur = 0;
+      if (!is_big[i]) {
+        --rest_bins;
+        mean_size = rest_cnt / static_cast<double>(std::max(rest_bins, 1));
+      }
+    }
+  }
+  for (size_t i = 0; i < uppers.size(); ++i) {
+    double v = (uppers[i] + lowers[i + 1]) / 2.0;
+    if (nb == 0 || !check_eq(out_bounds[nb - 1], v)) out_bounds[nb++] = v;
+  }
+  out_bounds[nb++] = std::numeric_limits<double>::infinity();
+  return nb;
+}
+
+// ---------------------------------------------------------------------------
+// Distinct-value extraction from a sorted sample (bin.cpp:355-380 behavior):
+// merges near-equal neighbours keeping the larger value. Returns count.
+// ---------------------------------------------------------------------------
+int lgbt_distinct(const double* sorted_values, int n, double* out_vals,
+                  int* out_counts) {
+  if (n == 0) return 0;
+  int k = 0;
+  out_vals[0] = sorted_values[0];
+  out_counts[0] = 1;
+  for (int i = 1; i < n; ++i) {
+    double prev = out_vals[k];
+    double tol = 1e-9 * std::max(std::fabs(prev),
+                                 std::fabs(sorted_values[i]));
+    if (sorted_values[i] > prev + tol) {
+      ++k;
+      out_vals[k] = sorted_values[i];
+      out_counts[k] = 1;
+    } else {
+      out_vals[k] = sorted_values[i];  // keep larger
+      ++out_counts[k];
+    }
+  }
+  return k + 1;
+}
+
+// ---------------------------------------------------------------------------
+// Buffered delimited-text parser (reference src/io/parser.cpp CSVParser /
+// TSVParser + pipeline_reader.h). Parses a whole file of numeric rows into
+// a dense row-major buffer. Returns rows parsed, or -1 on error;
+// *out_cols reports detected column count.
+// ---------------------------------------------------------------------------
+long lgbt_parse_delimited(const char* path, char delim, int skip_rows,
+                          double* out, long max_rows, int max_cols,
+                          int* out_cols) {
+  FILE* fp = std::fopen(path, "rb");
+  if (!fp) return -1;
+  std::fseek(fp, 0, SEEK_END);
+  long fsize = std::ftell(fp);
+  std::fseek(fp, 0, SEEK_SET);
+  std::vector<char> buf(fsize + 1);
+  long rd = static_cast<long>(std::fread(buf.data(), 1, fsize, fp));
+  std::fclose(fp);
+  buf[rd] = '\0';
+
+  long row = 0;
+  int ncols = -1;
+  char* p = buf.data();
+  char* end = buf.data() + rd;
+  for (int s = 0; s < skip_rows && p < end; ++s) {
+    while (p < end && *p != '\n') ++p;
+    if (p < end) ++p;
+  }
+  while (p < end && row < max_rows) {
+    if (*p == '\n' || *p == '\r') { ++p; continue; }
+    int col = 0;
+    while (p < end && *p != '\n') {
+      char* q;
+      double v = std::strtod(p, &q);
+      if (q == p) {  // unparsable token; skip to next delim
+        while (p < end && *p != delim && *p != '\n') ++p;
+        v = std::nan("");
+      } else {
+        p = q;
+      }
+      if (col < max_cols) out[row * max_cols + col] = v;
+      ++col;
+      if (p < end && *p == delim) ++p;
+      else break;
+    }
+    while (p < end && *p != '\n') ++p;
+    if (p < end) ++p;
+    if (ncols < 0) ncols = col;
+    for (int c = col; c < max_cols && c < ncols; ++c)
+      out[row * max_cols + c] = 0.0;
+    ++row;
+  }
+  *out_cols = ncols < 0 ? 0 : std::min(ncols, max_cols);
+  return row;
+}
+
+// Count rows/columns for pre-allocation.
+long lgbt_count_rows(const char* path, char delim, int* out_cols) {
+  FILE* fp = std::fopen(path, "rb");
+  if (!fp) return -1;
+  std::vector<char> chunk(1 << 20);
+  long rows = 0;
+  int cols = 1;
+  bool first_line = true;
+  bool line_started = false;
+  size_t got;
+  while ((got = std::fread(chunk.data(), 1, chunk.size(), fp)) > 0) {
+    for (size_t i = 0; i < got; ++i) {
+      char c = chunk[i];
+      if (c == '\n') {
+        if (line_started) ++rows;
+        first_line = false;
+        line_started = false;
+      } else if (c != '\r') {
+        line_started = true;
+        if (first_line && c == delim) ++cols;
+      }
+    }
+  }
+  if (line_started) ++rows;
+  std::fclose(fp);
+  *out_cols = cols;
+  return rows;
+}
+
+// ---------------------------------------------------------------------------
+// Vectorized value->bin mapping (bin.h:149 ValueToBin): branchless binary
+// search over upper bounds, NaN -> nan_bin (or default_bin).
+// ---------------------------------------------------------------------------
+void lgbt_values_to_bins(const double* values, long n, const double* bounds,
+                         int num_search_bounds, int nan_bin, uint8_t* out) {
+  for (long i = 0; i < n; ++i) {
+    double v = values[i];
+    if (std::isnan(v)) {
+      out[i] = static_cast<uint8_t>(nan_bin);
+      continue;
+    }
+    int lo = 0, hi = num_search_bounds;
+    while (lo < hi) {
+      int mid = (lo + hi) >> 1;
+      if (bounds[mid] < v) lo = mid + 1;
+      else hi = mid;
+    }
+    out[i] = static_cast<uint8_t>(lo);
+  }
+}
+
+}  // extern "C"

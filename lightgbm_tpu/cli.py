@@ -1,0 +1,250 @@
+"""Command-line application (reference src/application/, src/main.cpp).
+
+Same invocation contract as the reference CLI:
+    lightgbm-tpu config=train.conf [key=value ...]
+with tasks train / predict / refit / save_binary / convert_model
+(application.cpp:85-269) and `key=value` config files ('#' comments,
+CLI overrides file — application.cpp:50-83).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .callback import log_evaluation
+from .config import Config, parse_config_file
+from .engine import train as train_fn
+from .utils.log import Log
+
+__all__ = ["main", "Application"]
+
+
+def _parse_argv(argv: List[str]) -> Dict[str, str]:
+    params: Dict[str, str] = {}
+    for arg in argv:
+        if "=" not in arg:
+            Log.warning("Unknown CLI argument %s (expected key=value)", arg)
+            continue
+        key, value = arg.split("=", 1)
+        params[key.strip()] = value.strip()
+    file_params: Dict[str, str] = {}
+    if "config" in params or "config_file" in params:
+        path = params.get("config") or params.get("config_file")
+        file_params = parse_config_file(path)
+    # CLI overrides config file (application.cpp:75-80)
+    file_params.update(params)
+    return file_params
+
+
+def _load_text_data(path: str, cfg: Config):
+    """Load CSV/TSV/LibSVM training file.
+
+    Reference Parser auto-detection (src/io/parser.cpp): tab/comma sniffing,
+    label in column `label_column` (default 0).
+    """
+    with open(path) as fh:
+        first = fh.readline().strip()
+    if ":" in first.split(" ")[-1] and "," not in first:
+        # LibSVM format: label idx:val idx:val ...
+        return _load_libsvm(path)
+    delim = "\t" if "\t" in first else ","
+    skip = 1 if cfg.header else 0
+    from . import cext
+    data = cext.parse_delimited(path, delim, skip)  # native parser
+    if data is None:
+        data = np.loadtxt(path, delimiter=delim, skiprows=skip, ndmin=2)
+    label_col = 0
+    if cfg.label_column.startswith("name:"):
+        Log.fatal("label_column=name: requires header parsing; use index")
+    elif cfg.label_column:
+        label_col = int(cfg.label_column)
+    y = data[:, label_col].astype(np.float32)
+    X = np.delete(data, label_col, axis=1)
+    return X, y
+
+
+def _load_libsvm(path: str):
+    rows = []
+    labels = []
+    max_idx = -1
+    with open(path) as fh:
+        for line in fh:
+            parts = line.strip().split()
+            if not parts:
+                continue
+            labels.append(float(parts[0]))
+            feats = {}
+            for tok in parts[1:]:
+                i, v = tok.split(":")
+                feats[int(i)] = float(v)
+                max_idx = max(max_idx, int(i))
+            rows.append(feats)
+    X = np.zeros((len(rows), max_idx + 1))
+    for r, feats in enumerate(rows):
+        for i, v in feats.items():
+            X[r, i] = v
+    return X, np.asarray(labels, np.float32)
+
+
+def _maybe_load_group(data_path: str) -> Optional[np.ndarray]:
+    """LightGBM reads <data>.query / <data>.group side files."""
+    import os
+    for ext in (".query", ".group"):
+        p = data_path + ext
+        if os.path.exists(p):
+            return np.loadtxt(p, dtype=np.int64, ndmin=1)
+    return None
+
+
+def _maybe_load_weight(data_path: str) -> Optional[np.ndarray]:
+    import os
+    p = data_path + ".weight"
+    if os.path.exists(p):
+        return np.loadtxt(p, dtype=np.float32, ndmin=1)
+    return None
+
+
+class Application:
+    """Task dispatcher (reference application.cpp:31-269)."""
+
+    def __init__(self, argv: List[str]):
+        self.params = _parse_argv(argv)
+        self.config = Config(self.params)
+        Log.set_verbosity(self.config.verbosity)
+
+    def run(self) -> None:
+        task = self.config.task
+        if task == "train":
+            self.train()
+        elif task in ("predict", "prediction", "test"):
+            self.predict()
+        elif task == "refit":
+            self.refit()
+        elif task == "convert_model":
+            self.convert_model()
+        else:
+            Log.fatal("Unknown task %s", task)
+
+    # ------------------------------------------------------------------
+    def train(self) -> None:
+        cfg = self.config
+        if not cfg.data:
+            Log.fatal("No training data: set data=<file>")
+        X, y = _load_text_data(cfg.data, cfg)
+        group = _maybe_load_group(cfg.data)
+        weight = _maybe_load_weight(cfg.data)
+        dtrain = Dataset(X, label=y, group=group, weight=weight,
+                         params=dict(self.params))
+        valid_sets, valid_names = [], []
+        if cfg.valid:
+            for i, vpath in enumerate(str(cfg.valid).split(",")):
+                vX, vy = _load_text_data(vpath, cfg)
+                vgroup = _maybe_load_group(vpath)
+                valid_sets.append(Dataset(vX, label=vy, group=vgroup,
+                                          reference=dtrain))
+                valid_names.append(f"valid_{i + 1}")
+        callbacks = [log_evaluation(cfg.metric_freq)]
+        booster = train_fn(dict(self.params), dtrain,
+                           num_boost_round=cfg.num_iterations,
+                           valid_sets=valid_sets or None,
+                           valid_names=valid_names or None,
+                           callbacks=callbacks)
+        booster.save_model(cfg.output_model)
+        Log.info("Finished training, model saved to %s", cfg.output_model)
+
+    def predict(self) -> None:
+        cfg = self.config
+        if not cfg.data:
+            Log.fatal("No prediction data: set data=<file>")
+        if not cfg.input_model:
+            Log.fatal("No model file: set input_model=<file>")
+        booster = Booster(model_file=cfg.input_model)
+        X, _ = _load_text_data(cfg.data, cfg)
+        pred = booster.predict(
+            X, raw_score=cfg.predict_raw_score,
+            pred_leaf=cfg.predict_leaf_index,
+            pred_contrib=cfg.predict_contrib,
+            start_iteration=cfg.start_iteration_predict,
+            num_iteration=cfg.num_iteration_predict)
+        out = np.asarray(pred)
+        if out.ndim == 1:
+            out = out[:, None]
+        np.savetxt(cfg.output_result, out, delimiter="\t", fmt="%.18g")
+        Log.info("Finished prediction, results saved to %s",
+                 cfg.output_result)
+
+    def refit(self) -> None:
+        cfg = self.config
+        booster = Booster(model_file=cfg.input_model)
+        X, y = _load_text_data(cfg.data, cfg)
+        new_booster = booster.refit(X, y, decay_rate=cfg.refit_decay_rate)
+        new_booster.save_model(cfg.output_model)
+        Log.info("Finished refit, model saved to %s", cfg.output_model)
+
+    def convert_model(self) -> None:
+        cfg = self.config
+        booster = Booster(model_file=cfg.input_model)
+        model = booster._host_model()
+        code = _model_to_if_else(model)
+        with open(cfg.convert_model, "w") as fh:
+            fh.write(code)
+        Log.info("Model converted to %s", cfg.convert_model)
+
+
+def _model_to_if_else(model) -> str:
+    """C++ if-else codegen (reference SaveModelToIfElse,
+    gbdt_model_text.cpp:286 / Tree::ToIfElse tree.cpp)."""
+    lines = ["#include <cmath>", "#include <cstdint>", "",
+             "// generated by lightgbm_tpu convert_model", ""]
+    for ti, t in enumerate(model.trees):
+        lines.append(f"double PredictTree{ti}(const double* arr) {{")
+
+        def emit(node, indent):
+            pad = "  " * indent
+            if node < 0:
+                return [f"{pad}return {t.leaf_value[~node]!r};"]
+            f = int(t.split_feature[node])
+            thr = float(t.threshold[node])
+            dt = int(t.decision_type[node])
+            cond = f"arr[{f}] <= {thr!r}"
+            if dt & 1:
+                cond = f"static_cast<int>(arr[{f}]) == (int){thr!r}"
+            out = [f"{pad}if ({cond}) {{"]
+            out += emit(int(t.left_child[node]), indent + 1)
+            out += [f"{pad}}} else {{"]
+            out += emit(int(t.right_child[node]), indent + 1)
+            out += [f"{pad}}}"]
+            return out
+
+        if t.num_leaves <= 1:
+            lines.append(f"  return {float(t.leaf_value[0])!r};")
+        else:
+            lines.extend(emit(0, 1))
+        lines.append("}")
+        lines.append("")
+    n = len(model.trees)
+    lines.append("double Predict(const double* arr) {")
+    lines.append("  double sum = 0.0;")
+    for ti in range(n):
+        lines.append(f"  sum += PredictTree{ti}(arr);")
+    lines.append("  return sum;")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    try:
+        Application(argv).run()
+    except Exception as e:  # mirror main.cpp catch-all
+        Log.warning("Met Exceptions: %s", str(e))
+        raise
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

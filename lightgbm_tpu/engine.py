@@ -89,10 +89,6 @@ def train(params: Dict[str, Any], train_set: Dataset,
             int(params["early_stopping_round"]) > 0:
         cbs.add(callback_mod.early_stopping(
             int(params["early_stopping_round"]), first_metric_only))
-    if params.get("verbosity", params.get("verbose", 1)) >= 1 and not any(
-            getattr(cb, "order", 0) == 10 and
-            not getattr(cb, "before_iteration", False) for cb in cbs):
-        pass  # reference does not auto-add log_evaluation; user opts in
     callbacks_before = {cb for cb in cbs
                         if getattr(cb, "before_iteration", False)}
     callbacks_after = cbs - callbacks_before

@@ -70,6 +70,9 @@ class _GrowState(NamedTuple):
     slot_of_node: jax.Array    # [M+1] i32, -1 = not in frontier this pass
     slot_nodes: jax.Array      # [S] i32 node id per slot; M = inactive
     best: BestSplits           # per-NODE arrays [M+1]
+    cons_min: jax.Array        # [M+1] monotone lower bound per node
+    cons_max: jax.Array        # [M+1] monotone upper bound per node
+    path_mask: jax.Array       # [M+1, F] features used on root path (or [1,1])
     pass_idx: jax.Array
     done: jax.Array
 
@@ -112,14 +115,21 @@ def _merge_gathered_best(gathered: BestSplits) -> BestSplits:
 @functools.partial(
     jax.jit,
     static_argnames=("num_leaves", "max_depth", "hp", "leafwise", "bmax",
-                     "feature_block", "max_passes", "comm"))
+                     "feature_block", "max_passes", "comm",
+                     "interaction_groups", "feature_fraction_bynode",
+                     "hist_impl"))
 def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               cnt_weight: jax.Array, feature_mask: jax.Array,
               num_bins: jax.Array, missing_is_nan: jax.Array,
               is_cat_feat: jax.Array, *, num_leaves: int, max_depth: int,
               hp: SplitHyperParams, leafwise: bool = False, bmax: int,
               feature_block: int = 8, max_passes: int = 0,
-              comm: Optional[CommSpec] = None
+              comm: Optional[CommSpec] = None,
+              monotone: Optional[jax.Array] = None,
+              interaction_groups: Optional[tuple] = None,
+              feature_fraction_bynode: float = 1.0,
+              rng_key: Optional[jax.Array] = None,
+              hist_impl: str = "scatter"
               ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree. grad/hess must already include bagging/objective
     weights (zeros for out-of-bag rows); `cnt_weight` is 1.0 for in-bag rows
@@ -169,12 +179,35 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         right_output=jnp.zeros(m + 1, jnp.float32),
         per_feature_gain=jnp.zeros((1, 1), jnp.float32))
 
+    use_interaction = interaction_groups is not None and \
+        len(interaction_groups) > 0
+    if use_interaction:
+        # group masks [G, F]; allowed(node) = union of groups that contain
+        # the node's full path-feature set (reference ColSampler
+        # interaction-constraint filtering, col_sampler.hpp:20)
+        import numpy as _np
+        gm = _np.zeros((len(interaction_groups), f), _np.bool_)
+        for gi, grp in enumerate(interaction_groups):
+            for fi in grp:
+                if 0 <= fi < f:
+                    gm[gi, fi] = True
+        group_masks = jnp.asarray(gm)
+        path_mask0 = jnp.zeros((m + 1, f), bool)
+    else:
+        group_masks = None
+        path_mask0 = jnp.zeros((1, 1), bool)
+    use_bynode = feature_fraction_bynode < 1.0 and rng_key is not None
+    k_bynode = max(1, int(round(feature_fraction_bynode * f)))
+
     state = _GrowState(
         tree=tree,
         row_node=jnp.zeros(n, jnp.int32),
         slot_of_node=jnp.full(m + 1, -1, jnp.int32).at[0].set(0),
         slot_nodes=jnp.full(s, m, jnp.int32).at[0].set(0),
         best=best0,
+        cons_min=jnp.full(m + 1, -jnp.inf, jnp.float32),
+        cons_max=jnp.full(m + 1, jnp.inf, jnp.float32),
+        path_mask=path_mask0,
         pass_idx=jnp.asarray(0, jnp.int32),
         done=jnp.asarray(False))
 
@@ -185,28 +218,61 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         tree = st.tree
         # ---- 1. histograms for frontier slots ----
         row_slot = st.slot_of_node[st.row_node]            # [N]
-        hist = build_histograms(bins, grad, hess, row_slot, num_slots=s,
-                                bmax=bmax, feature_block=feature_block)
+        if hist_impl == "pallas":
+            from .histogram_pallas import build_histograms_pallas
+            hist = build_histograms_pallas(
+                bins, grad, hess, cnt_weight, row_slot, num_slots=s,
+                bmax=bmax)
+        else:
+            hist = build_histograms(bins, grad, hess, row_slot, cnt_weight,
+                                    num_slots=s, bmax=bmax,
+                                    feature_block=feature_block)
         # ---- 2. best-split scan per slot (with collectives if parallel) ----
         sn = st.slot_nodes                                  # [S] (M=dummy)
+
+        # per-slot feature mask: bytree fraction x bynode sample x
+        # interaction-allowed set (reference ColSampler, col_sampler.hpp:20)
+        slot_fmask = jnp.broadcast_to(feature_mask[None, :], (s, f))
+        if use_bynode:
+            ku = jax.random.fold_in(rng_key, st.pass_idx)
+            u = jax.random.uniform(ku, (s, f))
+            u = jnp.where(feature_mask[None, :] > 0, u, jnp.inf)
+            kth = jnp.sort(u, axis=1)[:, k_bynode - 1][:, None]
+            slot_fmask = slot_fmask * (u <= kth)
+        if use_interaction:
+            pm = st.path_mask[sn]                           # [S, F]
+            subset = jnp.all((~pm[:, None, :]) | group_masks[None, :, :],
+                             axis=2)                        # [S, G]
+            allowed = jnp.einsum("sg,gf->sf", subset.astype(jnp.float32),
+                                 group_masks.astype(jnp.float32)) > 0
+            allowed = allowed | pm  # path features stay available
+            slot_fmask = slot_fmask * allowed
+        rand_bins = None
+        if hp.extra_trees and rng_key is not None:
+            kr = jax.random.fold_in(jax.random.fold_in(rng_key, 7919),
+                                    st.pass_idx)
+            rand_bins = jax.random.randint(kr, (s, f), 0, bmax)
+        mono_kw = dict(monotone=monotone, cons_min=st.cons_min[sn],
+                       cons_max=st.cons_max[sn], depth=tree.depth[sn],
+                       rand_bins=rand_bins)
 
         def scan_hist(h, fm):
             return find_best_splits(
                 h, tree.sum_grad[sn], tree.sum_hess[sn], tree.count[sn],
                 tree.leaf_value[sn], num_bins, missing_is_nan, is_cat_feat,
-                fm, hp)
+                fm, hp, **mono_kw)
 
         if comm is None:
-            bs = scan_hist(hist, feature_mask)
+            bs = scan_hist(hist, slot_fmask)
         elif comm.mode == "data":
             # histogram merge == the ReduceScatter of
             # data_parallel_tree_learner.cpp:184-186; psum lets every device
             # scan all features (no best-split sync round needed after)
-            bs = scan_hist(jax.lax.psum(hist, comm.axis), feature_mask)
+            bs = scan_hist(jax.lax.psum(hist, comm.axis), slot_fmask)
         elif comm.mode == "feature":
             # local scan over this device's feature shard, then global
             # max-gain sync (feature_parallel_tree_learner.cpp:58-84)
-            local = scan_hist(hist, feature_mask)
+            local = scan_hist(hist, slot_fmask)
             gathered = BestSplits(*[
                 jax.lax.all_gather(getattr(local, fld), comm.axis)
                 for fld in BestSplits._fields])
@@ -225,7 +291,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 tree.sum_hess[sn] / comm.num_devices,
                 tree.count[sn] / comm.num_devices,
                 tree.leaf_value[sn], num_bins, missing_is_nan, is_cat_feat,
-                feature_mask, hp_local)
+                slot_fmask, hp_local, **mono_kw)
             k_vote = min(comm.top_k, f)
             _, vote_idx = jax.lax.top_k(local.per_feature_gain, k_vote)
             votes = jnp.zeros((s, f), jnp.float32)
@@ -239,7 +305,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 lambda v, i: v.at[i].set(1.0))(sel_mask, sel_idx)
             hist_sel = hist * sel_mask[:, :, None, None]
             ghist = jax.lax.psum(hist_sel, comm.axis)
-            bs = scan_hist(ghist, sel_mask * feature_mask[None, :])
+            bs = scan_hist(ghist, sel_mask * slot_fmask)
         # scatter slot results into per-node best arrays (dummy -> row m)
         best = BestSplits(*[
             getattr(st.best, fld).at[sn].set(getattr(bs, fld))
@@ -308,6 +374,32 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             gain=scat(best.gain, jnp.full(m + 1, -jnp.inf, jnp.float32),
                       jnp.full(m + 1, -jnp.inf, jnp.float32)))
 
+        # monotone bound propagation (basic method: after a split on a
+        # monotone feature, mid = (l_out + r_out)/2 caps the increasing
+        # side and floors the other — monotone_constraints.hpp
+        # BasicLeafConstraints::UpdateConstraints)
+        if hp.has_monotone:
+            mcf = monotone[jnp.clip(feat, 0, f - 1)]
+            mid = (best.left_output + best.right_output) * 0.5
+            pmin, pmax = st.cons_min, st.cons_max
+            lmin = jnp.where(mcf < 0, jnp.maximum(pmin, mid), pmin)
+            lmax = jnp.where(mcf > 0, jnp.minimum(pmax, mid), pmax)
+            rmin = jnp.where(mcf > 0, jnp.maximum(pmin, mid), pmin)
+            rmax = jnp.where(mcf < 0, jnp.minimum(pmax, mid), pmax)
+            cons_min = scat(st.cons_min, lmin, rmin)
+            cons_max = scat(st.cons_max, lmax, rmax)
+        else:
+            cons_min, cons_max = st.cons_min, st.cons_max
+        if use_interaction:
+            fsel = (jnp.arange(f)[None, :] ==
+                    jnp.clip(feat, 0, f - 1)[:, None]) & \
+                split_mask[:, None]                        # [M+1, F]
+            child_pm = st.path_mask | fsel
+            path_mask = st.path_mask.at[child_l].set(child_pm) \
+                .at[child_r].set(child_pm)
+        else:
+            path_mask = st.path_mask
+
         # ---- 5. frontier slots for the children ----
         slot_l = jnp.where(split_mask, 2 * order, s)
         slot_r = jnp.where(split_mask, 2 * order + 1, s)
@@ -336,7 +428,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
         done = (k == 0) | (new_tree.num_leaves >= num_leaves)
         return _GrowState(new_tree, row_node, slot_of_node, slot_nodes,
-                          new_best, st.pass_idx + 1, done)
+                          new_best, cons_min, cons_max, path_mask,
+                          st.pass_idx + 1, done)
 
     final = jax.lax.while_loop(cond, body, state)
     return final.tree, final.row_node

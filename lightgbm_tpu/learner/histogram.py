@@ -28,7 +28,8 @@ __all__ = ["build_histograms"]
 @functools.partial(jax.jit, static_argnames=("num_slots", "bmax",
                                              "feature_block"))
 def build_histograms(bins: jax.Array, grad: jax.Array, hess: jax.Array,
-                     row_slot: jax.Array, *, num_slots: int, bmax: int,
+                     row_slot: jax.Array, cnt: jax.Array = None, *,
+                     num_slots: int, bmax: int,
                      feature_block: int = 8) -> jax.Array:
     """Build per-slot histograms.
 
@@ -47,7 +48,9 @@ def build_histograms(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     """
     n, f = bins.shape
     slot = row_slot.astype(jnp.int32)
-    data = jnp.stack([grad, hess, jnp.ones_like(grad)], axis=-1)  # [N, 3]
+    if cnt is None:
+        cnt = jnp.ones_like(grad)
+    data = jnp.stack([grad, hess, cnt], axis=-1)  # [N, 3]
 
     fb = min(feature_block, f)
     num_blocks = (f + fb - 1) // fb

@@ -45,13 +45,17 @@ class SplitHyperParams:
     max_cat_threshold: int = 32
     max_cat_to_onehot: int = 4
     min_data_per_group: int = 100
+    has_monotone: bool = False     # enables the constrained-output gain path
+    monotone_penalty: float = 0.0
+    extra_trees: bool = False      # one random threshold per (slot, feature)
+    has_categorical: bool = False  # enables the categorical scan paths
 
 
 class BestSplits(NamedTuple):
     """Per-slot best split (reference SplitInfo, split_info.hpp:22)."""
     gain: jax.Array          # [S] split gain (already minus gain_shift)
     feature: jax.Array       # [S] used-feature index, -1 if none
-    threshold_bin: jax.Array  # [S] bin t: left iff bin <= t (== t for 1-hot cat)
+    threshold_bin: jax.Array  # [S] bin t: numerical left iff bin <= t
     default_left: jax.Array  # [S] bool, NaN direction
     left_grad: jax.Array     # [S]
     left_hess: jax.Array
@@ -59,6 +63,7 @@ class BestSplits(NamedTuple):
     left_output: jax.Array   # [S]
     right_output: jax.Array  # [S]
     per_feature_gain: jax.Array  # [S, F] best gain per feature (for voting)
+    cat_bitset: jax.Array    # [S, W] uint32; categorical: bin in set -> left
 
 
 def _threshold_l1(s, l1):
@@ -103,13 +108,28 @@ def _split_gain(lg, lh, lc, rg, rh, rc, l1, l2, hp: SplitHyperParams,
                       rc, parent_output))
 
 
+def _monotone_penalty_factor(depth: jax.Array, p: float) -> jax.Array:
+    """ComputeMonotoneSplitGainPenalty (monotone_constraints.hpp:355-364)."""
+    eps = 1e-10
+    d = depth.astype(jnp.float32)
+    small = 1.0 - p / jnp.exp2(d) + eps
+    large = 1.0 - jnp.exp2(p - 1.0 - d) + eps
+    out = jnp.where(p <= 1.0, small, large)
+    return jnp.where(p >= d + 1.0, eps, out)
+
+
 @functools.partial(jax.jit, static_argnames=("hp",))
 def find_best_splits(hist: jax.Array, parent_grad: jax.Array,
                      parent_hess: jax.Array, parent_count: jax.Array,
                      parent_output: jax.Array, num_bins: jax.Array,
                      missing_is_nan: jax.Array, is_cat: jax.Array,
                      feature_mask: jax.Array,
-                     hp: SplitHyperParams) -> BestSplits:
+                     hp: SplitHyperParams,
+                     monotone: jax.Array = None,
+                     cons_min: jax.Array = None,
+                     cons_max: jax.Array = None,
+                     depth: jax.Array = None,
+                     rand_bins: jax.Array = None) -> BestSplits:
     """Find the best split per slot.
 
     Args:
@@ -151,6 +171,12 @@ def find_best_splits(hist: jax.Array, parent_grad: jax.Array,
     valid_t = bins_r[None, None, :] <= t_limit[None, :, None]      # [1,F,B]
     valid_t = valid_t & (~is_cat[None, :, None]) & \
         (fmask[:, :, None] > 0)                                    # [S,F,B]
+    if hp.extra_trees and rand_bins is not None:
+        # extra-trees: evaluate ONE random threshold per (slot, feature)
+        # (reference USE_RAND specialization, feature_histogram.hpp:85)
+        valid_t = valid_t & (bins_r[None, None, :] ==
+                             (rand_bins % jnp.maximum(t_limit + 1, 1)
+                              [None, :])[:, :, None])
 
     def eval_option(left):                                         # [S,F,B,3]
         right = tot - left
@@ -159,8 +185,31 @@ def find_best_splits(hist: jax.Array, parent_grad: jax.Array,
         ok = ((lc >= hp.min_data_in_leaf) & (rc >= hp.min_data_in_leaf) &
               (lh >= hp.min_sum_hessian_in_leaf) &
               (rh >= hp.min_sum_hessian_in_leaf))
-        g = _split_gain(lg, lh, lc, rg, rh, rc, l1, l2, hp,
-                        parent_output[:, None, None])
+        if hp.has_monotone:
+            # constrained-output gain path (GetSplitGains USE_MC branch,
+            # feature_histogram.hpp:806-824): clamp child outputs to the
+            # node's [min, max] constraint, kill order-violating splits
+            po = parent_output[:, None, None]
+            lout = leaf_output(lg, lh, l1, l2, hp.max_delta_step,
+                               hp.path_smooth, lc, po)
+            rout = leaf_output(rg, rh, l1, l2, hp.max_delta_step,
+                               hp.path_smooth, rc, po)
+            cmin = cons_min[:, None, None]
+            cmax = cons_max[:, None, None]
+            lout = jnp.clip(lout, cmin, cmax)
+            rout = jnp.clip(rout, cmin, cmax)
+            mc = monotone[None, :, None]
+            violate = ((mc > 0) & (lout > rout)) | \
+                      ((mc < 0) & (lout < rout))
+            g = _gain_given_output(lg, lh, l1, l2, lout) + \
+                _gain_given_output(rg, rh, l1, l2, rout)
+            if hp.monotone_penalty > 0:
+                pen = _monotone_penalty_factor(depth, hp.monotone_penalty)
+                g = jnp.where(mc != 0, g * pen[:, None, None], g)
+            g = jnp.where(violate, -jnp.inf, g)
+        else:
+            g = _split_gain(lg, lh, lc, rg, rh, rc, l1, l2, hp,
+                            parent_output[:, None, None])
         return jnp.where(ok & valid_t, g, -jnp.inf)
 
     gain_na_right = eval_option(prefix)                       # NaN stays right
@@ -222,6 +271,9 @@ def find_best_splits(hist: jax.Array, parent_grad: jax.Array,
                        hp.path_smooth, lcs, parent_output)
     rout = leaf_output(rgs, rhs, l1, eff_l2, hp.max_delta_step,
                        hp.path_smooth, rcs, parent_output)
+    if hp.has_monotone:
+        lout = jnp.clip(lout, cons_min, cons_max)
+        rout = jnp.clip(rout, cons_min, cons_max)
     shift = jnp.where(best_is_cat, cat_gain_shift, gain_shift)
 
     # per-feature best gain (minus the feature's gain shift) for voting

@@ -1,0 +1,221 @@
+"""Plotting utilities (reference python-package/lightgbm/plotting.py, 690 LoC):
+plot_importance, plot_metric, plot_split_value_histogram, plot_tree /
+create_tree_digraph. Matplotlib/graphviz are imported lazily and optional.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from .basic import Booster
+from .sklearn import LGBMModel
+from .utils.log import Log
+
+__all__ = ["plot_importance", "plot_metric", "plot_split_value_histogram",
+           "plot_tree", "create_tree_digraph"]
+
+
+def _check_not_tuple_of_2_elements(obj, obj_name: str) -> None:
+    if not isinstance(obj, tuple) or len(obj) != 2:
+        raise TypeError(f"{obj_name} must be a tuple of 2 elements.")
+
+
+def _to_booster(booster) -> Booster:
+    if isinstance(booster, LGBMModel):
+        return booster.booster_
+    if isinstance(booster, Booster):
+        return booster
+    raise TypeError("booster must be Booster or LGBMModel.")
+
+
+def plot_importance(booster, ax=None, height: float = 0.2,
+                    xlim: Optional[Tuple] = None, ylim: Optional[Tuple] = None,
+                    title: str = "Feature importance",
+                    xlabel: str = "Feature importance",
+                    ylabel: str = "Features",
+                    importance_type: str = "auto",
+                    max_num_features: Optional[int] = None,
+                    ignore_zero: bool = True, figsize=None, dpi=None,
+                    grid: bool = True, precision: int = 3, **kwargs):
+    import matplotlib.pyplot as plt
+    bst = _to_booster(booster)
+    if importance_type == "auto":
+        importance_type = "split"
+    importance = bst.feature_importance(importance_type)
+    feature_name = bst.feature_name()
+    if not len(importance):
+        raise ValueError("Booster's feature_importance is empty.")
+    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
+    if ignore_zero:
+        tuples = [x for x in tuples if x[1] > 0]
+    if max_num_features is not None and max_num_features > 0:
+        tuples = tuples[-max_num_features:]
+    if not tuples:
+        raise ValueError("There are no importances to plot.")
+    labels, values = zip(*tuples)
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ylocs = np.arange(len(values))
+    ax.barh(ylocs, values, align="center", height=height, **kwargs)
+    for x, y in zip(values, ylocs):
+        ax.text(x + 1, y,
+                f"{x:.{precision}f}" if importance_type == "gain" else str(x),
+                va="center")
+    ax.set_yticks(ylocs)
+    ax.set_yticklabels(labels)
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+        ax.set_ylim(ylim)
+    if title:
+        ax.set_title(title)
+    if xlabel:
+        ax.set_xlabel(xlabel)
+    if ylabel:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_metric(booster: Union[Dict, Booster], metric: Optional[str] = None,
+                dataset_names: Optional[List[str]] = None, ax=None,
+                xlim=None, ylim=None, title: str = "Metric during training",
+                xlabel: str = "Iterations", ylabel: str = "@metric@",
+                figsize=None, dpi=None, grid: bool = True):
+    import matplotlib.pyplot as plt
+    if isinstance(booster, dict):
+        eval_results = booster
+    elif isinstance(booster, LGBMModel):
+        eval_results = dict(booster.evals_result_)
+    else:
+        raise TypeError("booster must be dict or LGBMModel.")
+    if not eval_results:
+        raise ValueError("eval results cannot be empty.")
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    names = dataset_names or list(eval_results.keys())
+    msets = eval_results[names[0]]
+    if metric is None:
+        metric = list(msets.keys())[0]
+    for name in names:
+        if metric not in eval_results.get(name, {}):
+            continue
+        results = eval_results[name][metric]
+        ax.plot(range(len(results)), results, label=name)
+    ax.legend(loc="best")
+    if title:
+        ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel.replace("@metric@", metric))
+    ax.grid(grid)
+    return ax
+
+
+def plot_split_value_histogram(booster, feature, bins=None, ax=None,
+                               width_coef: float = 0.8, xlim=None, ylim=None,
+                               title="Split value histogram for feature with "
+                                     "@index/name@ @feature@",
+                               xlabel="Feature split value", ylabel="Count",
+                               figsize=None, dpi=None, grid: bool = True):
+    import matplotlib.pyplot as plt
+    bst = _to_booster(booster)
+    model = bst._host_model()
+    if isinstance(feature, str):
+        fidx = model.feature_names.index(feature)
+    else:
+        fidx = int(feature)
+    values = []
+    for t in model.trees:
+        for i in range(t.num_leaves - 1):
+            if int(t.split_feature[i]) == fidx and \
+                    not (int(t.decision_type[i]) & 1):
+                values.append(float(t.threshold[i]))
+    if not values:
+        raise ValueError(
+            "Cannot plot split value histogram, "
+            f"because feature {feature} was not used in splitting")
+    hist, bin_edges = np.histogram(values, bins=bins or "auto")
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    centers = (bin_edges[:-1] + bin_edges[1:]) / 2
+    ax.bar(centers, hist, width=width_coef * (bin_edges[1] - bin_edges[0]))
+    if title:
+        title = title.replace("@feature@", str(feature)).replace(
+            "@index/name@", "name" if isinstance(feature, str) else "index")
+        ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def create_tree_digraph(booster, tree_index: int = 0,
+                        show_info: Optional[List[str]] = None,
+                        precision: int = 3, orientation: str = "horizontal",
+                        **kwargs):
+    import graphviz
+    bst = _to_booster(booster)
+    model = bst._host_model()
+    if tree_index >= len(model.trees):
+        raise IndexError("tree_index is out of range.")
+    t = model.trees[tree_index]
+    show_info = show_info or []
+    graph = graphviz.Digraph(**kwargs)
+    rankdir = "LR" if orientation == "horizontal" else "TB"
+    graph.attr(rankdir=rankdir)
+
+    def add(node, parent=None, decision=None):
+        if node < 0:
+            li = ~node
+            name = f"leaf{li}"
+            label = f"leaf {li}: {t.leaf_value[li]:.{precision}f}"
+            if "leaf_count" in show_info:
+                label += f"\ncount: {int(t.leaf_count[li])}"
+            if "leaf_weight" in show_info:
+                label += f"\nweight: {t.leaf_weight[li]:.{precision}f}"
+            graph.node(name, label=label)
+        else:
+            name = f"split{node}"
+            fname = model.feature_names[int(t.split_feature[node])] \
+                if model.feature_names else f"f{int(t.split_feature[node])}"
+            op = "==" if int(t.decision_type[node]) & 1 else "<="
+            label = f"{fname} {op} {t.threshold[node]:.{precision}f}"
+            if "split_gain" in show_info:
+                label += f"\ngain: {t.split_gain[node]:.{precision}f}"
+            if "internal_count" in show_info:
+                label += f"\ncount: {int(t.internal_count[node])}"
+            if "internal_value" in show_info:
+                label += f"\nvalue: {t.internal_value[node]:.{precision}f}"
+            graph.node(name, label=label)
+            add(int(t.left_child[node]), name, "yes")
+            add(int(t.right_child[node]), name, "no")
+        if parent is not None:
+            graph.edge(parent, name, decision)
+        return name
+
+    add(0 if t.num_leaves > 1 else -1)
+    return graph
+
+
+def plot_tree(booster, ax=None, tree_index: int = 0, figsize=None, dpi=None,
+              show_info=None, precision: int = 3,
+              orientation: str = "horizontal", **kwargs):
+    import io
+    import matplotlib.image as mpimg
+    import matplotlib.pyplot as plt
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    graph = create_tree_digraph(booster, tree_index=tree_index,
+                                show_info=show_info, precision=precision,
+                                orientation=orientation, **kwargs)
+    s = io.BytesIO(graph.pipe(format="png"))
+    img = mpimg.imread(s)
+    ax.imshow(img)
+    ax.axis("off")
+    return ax

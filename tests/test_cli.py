@@ -1,0 +1,78 @@
+"""CLI tests — mirrors the reference's examples-driven consistency tests
+(tests/cpp_tests/test.py runs CLI train.conf/predict.conf; the binary
+classification example layout from examples/binary_classification)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.cli import main
+from conftest import make_binary
+
+
+@pytest.fixture
+def example_dir(tmp_path):
+    X, y = make_binary(n=1200, f=8)
+    train = np.column_stack([y[:1000], X[:1000]])
+    test = np.column_stack([y[1000:], X[1000:]])
+    np.savetxt(tmp_path / "train.tsv", train, delimiter="\t")
+    np.savetxt(tmp_path / "test.tsv", test, delimiter="\t")
+    (tmp_path / "train.conf").write_text(f"""
+task = train
+objective = binary
+metric = auc
+data = {tmp_path}/train.tsv
+valid = {tmp_path}/test.tsv
+num_trees = 15
+num_leaves = 15
+learning_rate = 0.2
+output_model = {tmp_path}/model.txt
+verbosity = -1
+""")
+    (tmp_path / "predict.conf").write_text(f"""
+task = predict
+data = {tmp_path}/test.tsv
+input_model = {tmp_path}/model.txt
+output_result = {tmp_path}/preds.txt
+verbosity = -1
+""")
+    return tmp_path
+
+
+def test_cli_train_then_predict(example_dir):
+    main([f"config={example_dir}/train.conf"])
+    assert (example_dir / "model.txt").exists()
+    model_text = (example_dir / "model.txt").read_text()
+    assert model_text.startswith("tree\nversion=v3")
+    main([f"config={example_dir}/predict.conf"])
+    preds = np.loadtxt(example_dir / "preds.txt")
+    assert len(preds) == 200
+    assert np.all((preds >= 0) & (preds <= 1))
+    # predictions should be informative
+    test = np.loadtxt(example_dir / "test.tsv", delimiter="\t")
+    y = test[:, 0]
+    from lightgbm_tpu.metrics import AUCMetric
+    assert AUCMetric._auc_fast(preds, y > 0, np.ones(len(y))) > 0.9
+
+
+def test_cli_override_beats_config(example_dir, capsys):
+    main([f"config={example_dir}/train.conf", "num_trees=3",
+          f"output_model={example_dir}/model3.txt"])
+    text = (example_dir / "model3.txt").read_text()
+    assert text.count("Tree=") == 3
+
+
+def test_cli_convert_model(example_dir):
+    main([f"config={example_dir}/train.conf"])
+    main([f"task=convert_model", f"input_model={example_dir}/model.txt",
+          f"convert_model={example_dir}/model.cpp"])
+    code = (example_dir / "model.cpp").read_text()
+    assert "double PredictTree0" in code
+    assert "double Predict(" in code
+
+
+def test_cli_refit(example_dir):
+    main([f"config={example_dir}/train.conf"])
+    main([f"task=refit", f"data={example_dir}/train.tsv",
+          f"input_model={example_dir}/model.txt",
+          f"output_model={example_dir}/model_refit.txt"])
+    assert (example_dir / "model_refit.txt").exists()

@@ -1,0 +1,132 @@
+"""Monotone/interaction constraints, extra-trees, bynode sampling tests
+(reference test_engine.py monotone/interaction sections)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from conftest import make_binary, make_regression
+
+
+def _is_monotone_increasing(bst, feature_idx, X, n_grid=25):
+    """Check prediction is non-decreasing in the given feature."""
+    base = np.median(X, axis=0)
+    grid = np.linspace(X[:, feature_idx].min(), X[:, feature_idx].max(),
+                      n_grid)
+    rows = np.tile(base, (n_grid, 1))
+    rows[:, feature_idx] = grid
+    pred = bst.predict(rows, raw_score=True)
+    return np.all(np.diff(pred) >= -1e-9)
+
+
+class TestMonotone:
+    def test_increasing_constraint_enforced(self):
+        r = np.random.RandomState(0)
+        n = 4000
+        X = r.randn(n, 4)
+        # feature 0 has non-monotone true effect; constraint must flatten it
+        y = (np.sin(2 * X[:, 0]) + X[:, 1] +
+             0.1 * r.randn(n)).astype(np.float32)
+        mc = [1, 0, 0, 0]
+        bst = lgb.train({"objective": "regression", "verbosity": -1,
+                         "monotone_constraints": mc, "num_leaves": 31},
+                        lgb.Dataset(X, label=y), 30)
+        assert _is_monotone_increasing(bst, 0, X)
+
+    def test_decreasing_constraint_enforced(self):
+        r = np.random.RandomState(1)
+        n = 4000
+        X = r.randn(n, 3)
+        y = (np.cos(2 * X[:, 0]) - X[:, 2] +
+             0.1 * r.randn(n)).astype(np.float32)
+        bst = lgb.train({"objective": "regression", "verbosity": -1,
+                         "monotone_constraints": [-1, 0, 0],
+                         "num_leaves": 31}, lgb.Dataset(X, label=y), 30)
+        base = np.median(X, axis=0)
+        grid = np.linspace(X[:, 0].min(), X[:, 0].max(), 25)
+        rows = np.tile(base, (25, 1))
+        rows[:, 0] = grid
+        pred = bst.predict(rows, raw_score=True)
+        assert np.all(np.diff(pred) <= 1e-9)
+
+    def test_unconstrained_differs(self):
+        r = np.random.RandomState(0)
+        n = 4000
+        X = r.randn(n, 4)
+        y = (np.sin(2 * X[:, 0]) + X[:, 1] +
+             0.1 * r.randn(n)).astype(np.float32)
+        bst = lgb.train({"objective": "regression", "verbosity": -1,
+                         "num_leaves": 31}, lgb.Dataset(X, label=y), 30)
+        # sanity: without constraint the sine effect is non-monotone
+        assert not _is_monotone_increasing(bst, 0, X)
+
+    def test_monotone_penalty_runs(self):
+        X, y = make_regression()
+        bst = lgb.train({"objective": "regression", "verbosity": -1,
+                         "monotone_constraints": [1] + [0] * (X.shape[1] - 1),
+                         "monotone_penalty": 2.0},
+                        lgb.Dataset(X, label=y), 10)
+        assert bst.num_trees() == 10
+
+
+class TestInteractionConstraints:
+    def test_groups_respected(self):
+        X, y = make_binary(n=3000)
+        groups = [[0, 1], [2, 3, 4]]
+        bst = lgb.train({"objective": "binary", "verbosity": -1,
+                         "interaction_constraints": groups,
+                         "num_leaves": 15}, lgb.Dataset(X, label=y), 15)
+        model = bst._host_model()
+        allowed = [set(g) for g in groups]
+        for t in model.trees:
+            # collect per-path feature sets via recursion
+            def paths(node, used):
+                if node < 0:
+                    if used:
+                        ok = any(used <= a for a in allowed) or len(used) == 1
+                        assert ok, f"path features {used} violate constraints"
+                    return
+                fset = used | {int(t.split_feature[node])}
+                paths(int(t.left_child[node]), fset)
+                paths(int(t.right_child[node]), fset)
+            if t.num_leaves > 1:
+                paths(0, set())
+
+    def test_accuracy_retained(self):
+        X, y = make_binary(n=3000)
+        bst = lgb.train({"objective": "binary", "verbosity": -1,
+                         "interaction_constraints": [[0, 1, 2],
+                                                     [3, 4, 5, 6, 7, 8, 9]]},
+                        lgb.Dataset(X, label=y), 20)
+        from lightgbm_tpu.metrics import AUCMetric
+        auc = AUCMetric._auc_fast(bst.predict(X), y > 0, np.ones(len(y)))
+        assert auc > 0.9
+
+
+class TestExtraTrees:
+    def test_extra_trees_trains(self):
+        X, y = make_binary()
+        bst = lgb.train({"objective": "binary", "verbosity": -1,
+                         "extra_trees": True}, lgb.Dataset(X, label=y), 20)
+        from lightgbm_tpu.metrics import AUCMetric
+        auc = AUCMetric._auc_fast(bst.predict(X), y > 0, np.ones(len(y)))
+        assert auc > 0.85  # random thresholds still learn
+
+    def test_differs_from_exact(self):
+        X, y = make_binary()
+        b1 = lgb.train({"objective": "binary", "verbosity": -1},
+                       lgb.Dataset(X, label=y), 5)
+        b2 = lgb.train({"objective": "binary", "verbosity": -1,
+                        "extra_trees": True}, lgb.Dataset(X, label=y), 5)
+        assert not np.allclose(b1.predict(X), b2.predict(X))
+
+
+class TestFeatureFractionByNode:
+    def test_runs_and_learns(self):
+        X, y = make_binary()
+        bst = lgb.train({"objective": "binary", "verbosity": -1,
+                         "feature_fraction_bynode": 0.5},
+                        lgb.Dataset(X, label=y), 20)
+        from lightgbm_tpu.metrics import AUCMetric
+        auc = AUCMetric._auc_fast(bst.predict(X), y > 0, np.ones(len(y)))
+        assert auc > 0.9

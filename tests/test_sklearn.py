@@ -1,0 +1,113 @@
+"""sklearn API tests (reference tests/python_package_test/test_sklearn.py)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.sklearn import (LGBMClassifier, LGBMModel, LGBMRanker,
+                                  LGBMRegressor)
+
+from conftest import make_binary, make_multiclass, make_ranking, \
+    make_regression
+
+
+class TestRegressor:
+    def test_fit_predict(self):
+        X, y = make_regression()
+        reg = LGBMRegressor(n_estimators=30, num_leaves=15)
+        reg.fit(X, y)
+        pred = reg.predict(X)
+        mse = np.mean((pred - y) ** 2)
+        assert mse < np.var(y) * 0.2
+        assert reg.n_features_ == X.shape[1]
+
+    def test_eval_set_early_stopping(self):
+        X, y = make_regression(n=3000)
+        reg = LGBMRegressor(n_estimators=500, learning_rate=0.3)
+        reg.fit(X[:2000], y[:2000], eval_set=[(X[2000:], y[2000:])],
+                eval_metric="l2", early_stopping_rounds=5)
+        assert reg.best_iteration_ < 500
+        assert "valid_0" in reg.evals_result_
+
+    def test_feature_importances(self):
+        X, y = make_regression()
+        reg = LGBMRegressor(n_estimators=10).fit(X, y)
+        assert reg.feature_importances_.shape == (X.shape[1],)
+        assert reg.feature_importances_.sum() > 0
+
+    def test_params_passthrough(self):
+        X, y = make_regression()
+        reg = LGBMRegressor(n_estimators=5, reg_alpha=1.0, reg_lambda=2.0,
+                            subsample=0.8, subsample_freq=2,
+                            colsample_bytree=0.7, min_child_samples=10,
+                            random_state=7)
+        reg.fit(X, y)
+        cfg = reg.booster_.config
+        assert cfg.lambda_l1 == 1.0
+        assert cfg.lambda_l2 == 2.0
+        assert cfg.bagging_fraction == 0.8
+        assert cfg.feature_fraction == 0.7
+        assert cfg.min_data_in_leaf == 10
+        assert cfg.seed == 7
+
+    def test_get_set_params(self):
+        reg = LGBMRegressor(num_leaves=7)
+        params = reg.get_params()
+        assert params["num_leaves"] == 7
+        reg.set_params(num_leaves=15)
+        assert reg.get_params()["num_leaves"] == 15
+
+
+class TestClassifier:
+    def test_binary(self):
+        X, y = make_binary()
+        clf = LGBMClassifier(n_estimators=30)
+        clf.fit(X, y)
+        assert clf.n_classes_ == 2
+        proba = clf.predict_proba(X)
+        assert proba.shape == (len(y), 2)
+        np.testing.assert_allclose(proba.sum(1), 1.0, rtol=1e-6)
+        acc = np.mean(clf.predict(X) == y)
+        assert acc > 0.9
+
+    def test_multiclass(self):
+        X, y = make_multiclass()
+        clf = LGBMClassifier(n_estimators=20)
+        clf.fit(X, y)
+        assert clf.n_classes_ == 4
+        assert clf.predict_proba(X).shape == (len(y), 4)
+        acc = np.mean(clf.predict(X) == y)
+        assert acc > 0.8
+
+    def test_string_labels(self):
+        X, y = make_binary(n=1000)
+        labels = np.where(y > 0, "spam", "ham")
+        clf = LGBMClassifier(n_estimators=10)
+        clf.fit(X, labels)
+        pred = clf.predict(X)
+        assert set(pred) <= {"spam", "ham"}
+        assert np.mean(pred == labels) > 0.85
+
+    def test_class_weight_balanced(self):
+        X, y = make_binary(n=2000)
+        # unbalance the data
+        keep = np.where((y == 0) | (np.arange(len(y)) % 5 == 0))[0]
+        clf = LGBMClassifier(n_estimators=10, class_weight="balanced")
+        clf.fit(X[keep], y[keep])
+        assert clf.predict(X).mean() > 0.1  # not collapsed to majority
+
+
+class TestRanker:
+    def test_fit_predict(self):
+        X, y, group = make_ranking()
+        rk = LGBMRanker(n_estimators=20, num_leaves=15,
+                        min_child_samples=5)
+        rk.fit(X, y, group=group)
+        pred = rk.predict(X)
+        assert pred.shape == (len(y),)
+        # predictions should correlate with relevance
+        assert np.corrcoef(pred, y)[0, 1] > 0.5
+
+    def test_group_required(self):
+        X, y, _ = make_ranking()
+        with pytest.raises(ValueError):
+            LGBMRanker(n_estimators=2).fit(X, y)
