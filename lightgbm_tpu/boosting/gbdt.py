@@ -123,7 +123,8 @@ class GBDT:
             min_data_per_group=cfg.min_data_per_group,
             has_monotone=has_monotone,
             monotone_penalty=cfg.monotone_penalty,
-            extra_trees=cfg.extra_trees)
+            extra_trees=cfg.extra_trees,
+            has_categorical=bool(np.any(ds.is_categorical)))
         self._setup_parallel(cfg)
         # Pallas MXU histogram kernel on TPU-like backends (serial learner;
         # the sharded path keeps the portable scatter fallback for now)
@@ -376,6 +377,7 @@ class GBDT:
         return TreeArrays(
             split_feature=jnp.full(m1, -1, jnp.int32), threshold_bin=zi,
             default_left=zb, is_cat=zb,
+            cat_bitset=jnp.zeros((m1, (self.bmax + 31) // 32), jnp.uint32),
             left=jnp.full(m1, -1, jnp.int32),
             right=jnp.full(m1, -1, jnp.int32),
             parent=jnp.full(m1, -1, jnp.int32),

@@ -206,13 +206,52 @@ def _model_to_if_else(model) -> str:
         def emit(node, indent):
             pad = "  " * indent
             if node < 0:
-                return [f"{pad}return {t.leaf_value[~node]!r};"]
+                return [f"{pad}return {float(t.leaf_value[~node])!r};"]
             f = int(t.split_feature[node])
             thr = float(t.threshold[node])
             dt = int(t.decision_type[node])
-            cond = f"arr[{f}] <= {thr!r}"
             if dt & 1:
-                cond = f"static_cast<int>(arr[{f}]) == (int){thr!r}"
+                # categorical: threshold is a cat_boundaries index; decode
+                # the category-value bitset into an explicit membership
+                # test (reference Tree::ToIfElse CategoricalDecision /
+                # FindInBitset, tree.cpp)
+                ci = int(thr)
+                lo = int(t.cat_boundaries[ci])
+                hi = int(t.cat_boundaries[ci + 1])
+                vals = [(w - lo) * 32 + b for w in range(lo, hi)
+                        for b in range(32)
+                        if (int(t.cat_threshold[w]) >> b) & 1]
+                in_set = " || ".join(f"v{node} == {v}" for v in vals) \
+                    or "false"
+                # non-finite / negative / huge values go right like
+                # HostTree.predict_rows (tree.py) — also keeps the
+                # double->int cast defined
+                cond = (f"std::isfinite(arr[{f}]) && arr[{f}] >= 0.0 && "
+                        f"arr[{f}] < 2147483647.0 && "
+                        f"[&]{{ int v{node} = static_cast<int>(arr[{f}]); "
+                        f"return {in_set}; }}()")
+            else:
+                # numerical; mirror HostTree.predict_rows / reference
+                # NumericalDecision (tree.h:335-412): missing_type NAN
+                # routes NaN by default_left; NONE/ZERO first map NaN->0,
+                # then ZERO routes |v|<=kZeroThreshold by default_left
+                mt = (dt >> 2) & 3
+                dl = bool(dt & 2)
+                if mt == 2:
+                    if dl:
+                        cond = (f"std::isnan(arr[{f}]) || "
+                                f"arr[{f}] <= {thr!r}")
+                    else:
+                        cond = (f"!std::isnan(arr[{f}]) && "
+                                f"arr[{f}] <= {thr!r}")
+                elif mt == 1:
+                    cond = (f"[&]{{ double u{node} = std::isnan(arr[{f}])"
+                            f" ? 0.0 : arr[{f}]; "
+                            f"return std::fabs(u{node}) <= 1e-35 ? "
+                            f"{str(dl).lower()} : u{node} <= {thr!r}; }}()")
+                else:
+                    cond = (f"(std::isnan(arr[{f}]) ? 0.0 : arr[{f}])"
+                            f" <= {thr!r}")
             out = [f"{pad}if ({cond}) {{"]
             out += emit(int(t.left_child[node]), indent + 1)
             out += [f"{pad}}} else {{"]

@@ -47,9 +47,10 @@ class TreeArrays(NamedTuple):
     nodes carry split info, leaves carry output values.
     """
     split_feature: jax.Array   # i32, used-feature idx; -1 for leaf
-    threshold_bin: jax.Array   # i32; numerical: left iff bin <= t; cat: == t
+    threshold_bin: jax.Array   # i32; numerical: left iff bin <= t
     default_left: jax.Array    # bool (NaN direction)
-    is_cat: jax.Array          # bool
+    is_cat: jax.Array          # bool; decision: bin in cat_bitset -> left
+    cat_bitset: jax.Array      # [M+1, W] uint32 bin-bitset per node
     left: jax.Array            # i32 child id
     right: jax.Array           # i32 child id
     parent: jax.Array          # i32, -1 for root
@@ -78,7 +79,7 @@ class _GrowState(NamedTuple):
 
 
 def _init_tree(max_nodes: int, root_grad, root_hess, root_count,
-               root_value) -> TreeArrays:
+               root_value, bitset_words: int = 1) -> TreeArrays:
     m1 = max_nodes + 1
     zf = jnp.zeros(m1, jnp.float32)
     zi = jnp.zeros(m1, jnp.int32)
@@ -86,6 +87,7 @@ def _init_tree(max_nodes: int, root_grad, root_hess, root_count,
     return TreeArrays(
         split_feature=jnp.full(m1, -1, jnp.int32),
         threshold_bin=zi, default_left=zb, is_cat=zb,
+        cat_bitset=jnp.zeros((m1, bitset_words), jnp.uint32),
         left=jnp.full(m1, -1, jnp.int32), right=jnp.full(m1, -1, jnp.int32),
         parent=jnp.full(m1, -1, jnp.int32),
         leaf_value=zf.at[0].set(root_value),
@@ -106,6 +108,8 @@ def _merge_gathered_best(gathered: BestSplits) -> BestSplits:
     def pick(name, field):
         if name == "per_feature_gain":  # disjoint shards: elementwise max
             return jnp.max(field, axis=0)
+        if field.ndim == 3:             # [D, S, W] bitsets
+            return jnp.take_along_axis(field, win[None, :, None], axis=0)[0]
         return jnp.take_along_axis(field, win[None], axis=0)[0]
 
     return BestSplits(*[pick(f, getattr(gathered, f))
@@ -165,7 +169,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         root_c = jax.lax.psum(root_c, comm.axis)
     root_val = leaf_output(root_g, root_h, hp.lambda_l1, hp.lambda_l2,
                            hp.max_delta_step)
-    tree = _init_tree(m, root_g, root_h, root_c, root_val)
+    w_cat = (bmax + 31) // 32          # bitset words per node
+    tree = _init_tree(m, root_g, root_h, root_c, root_val, bitset_words=w_cat)
 
     best0 = BestSplits(
         gain=jnp.full(m + 1, -jnp.inf, jnp.float32),
@@ -177,7 +182,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         left_count=jnp.zeros(m + 1, jnp.float32),
         left_output=jnp.zeros(m + 1, jnp.float32),
         right_output=jnp.zeros(m + 1, jnp.float32),
-        per_feature_gain=jnp.zeros((1, 1), jnp.float32))
+        per_feature_gain=jnp.zeros((1, 1), jnp.float32),
+        cat_bitset=jnp.zeros((m + 1, w_cat), jnp.uint32))
 
     use_interaction = interaction_groups is not None and \
         len(interaction_groups) > 0
@@ -344,6 +350,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             is_cat=jnp.where(split_mask,
                              is_cat_feat[jnp.clip(feat, 0, f - 1)],
                              tree.is_cat),
+            cat_bitset=jnp.where(split_mask[:, None], best.cat_bitset,
+                                 tree.cat_bitset),
             left=jnp.where(split_mask, child_l, tree.left),
             right=jnp.where(split_mask, child_r, tree.right),
             gain=jnp.where(split_mask, best.gain, tree.gain),
@@ -420,8 +428,11 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         thr = best.threshold_bin[pnode]
         isc = is_cat_feat[pf]
         is_nan_bin = missing_is_nan[pf] & (binv == num_bins[pf] - 1)
+        bitw = best.cat_bitset[pnode, binv // 32]                  # [N]
+        in_set = ((bitw >> (binv % 32).astype(jnp.uint32)) &
+                  jnp.uint32(1)) == 1
         go_left = jnp.where(
-            isc, binv == thr,
+            isc, in_set,
             jnp.where(is_nan_bin, best.default_left[pnode], binv <= thr))
         row_node = jnp.where(
             pm, jnp.where(go_left, child_l[pnode], child_r[pnode]), pnode)

@@ -38,8 +38,11 @@ def _traverse(tree: TreeArrays, bins: jax.Array, num_bins: jax.Array,
         thr = tree.threshold_bin[node]
         isc = tree.is_cat[node]
         is_nan_bin = missing_is_nan[fc] & (binv == num_bins[fc] - 1)
+        bitw = tree.cat_bitset[node, binv // 32]
+        in_set = ((bitw >> (binv % 32).astype(jnp.uint32)) &
+                  jnp.uint32(1)) == 1
         go_left = jnp.where(
-            isc, binv == thr,
+            isc, in_set,
             jnp.where(is_nan_bin, tree.default_left[node], binv <= thr))
         nxt = jnp.where(go_left, tree.left[node], tree.right[node])
         return jnp.where(internal, nxt, node)

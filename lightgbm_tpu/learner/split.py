@@ -6,9 +6,9 @@ Replaces the reference's per-feature sequential gain scans
 missing-type, NA-direction}) with ONE batched computation over
 [slots, features, bins]: cumulative sums along the bin axis, the closed-form
 gain at every threshold, NA-left/NA-right evaluated as two masked variants,
-and a flat argmax. Categorical one-vs-rest scan included
-(feature_histogram.hpp:278-485; sorted top-k scan lives in
-categorical_sorted_scan below).
+and a flat argmax. Categorical splits (feature_histogram.hpp:278-485) use
+the one-hot scan for low-cardinality features and the sorted-by-ratio
+two-direction scan otherwise, emitting the left set as a bin bitset.
 
 All math follows feature_histogram.hpp:737-860:
   ThresholdL1(s, l1) = sign(s) * max(|s| - l1, 0)
@@ -217,30 +217,84 @@ def find_best_splits(hist: jax.Array, parent_grad: jax.Array,
         missing_is_nan[None, :, None],
         eval_option(prefix + nan_sums), -jnp.inf)             # NaN joins left
 
-    # ---------- categorical one-vs-rest ----------
-    # left = single category bin ("bin == t" decision); NaN/unseen (bin 0)
-    # always right. cat_l2/cat_smooth regularization per
-    # feature_histogram.hpp:508-560 (one-hot branch).
-    cat_valid = is_cat[None, :, None] & (fmask[:, :, None] > 0) & \
-        (bins_r[None, None, :] >= 1) & \
-        (bins_r[None, None, :] <= (num_bins[None, :, None] - 1))
+    # ---------- categorical ----------
+    # One-hot branch for low-cardinality features, sorted-by-ratio two-way
+    # scan otherwise, mirroring FindBestThresholdCategoricalInner
+    # (feature_histogram.hpp:278-485): one-hot gains use the ORIGINAL l2,
+    # sorted gains use l2 + cat_l2, gain_shift uses the original l2 in both;
+    # sorted scan keeps bins with count >= cat_smooth, sorts ascending by
+    # g/(h + cat_smooth), scans from both ends up to
+    # min(max_cat_threshold, (used+1)/2) categories. Bin 0 (unseen/NaN)
+    # always stays right. For a threshold at sorted position p the left set
+    # is the first p+1 bins in scan direction, emitted as a bin bitset.
+    # Deviation from the reference: the min_data_per_group group-batching
+    # (which merges tiny categories between gain evaluations) is applied
+    # only as a right-side floor, not as evaluation batching.
     cl2 = l2 + hp.cat_l2
-    lg, lh, lc = hist[..., 0], hist[..., 1], hist[..., 2]
-    rg = tot[..., 0] - lg
-    rh = tot[..., 1] - lh
-    rc = tot[..., 2] - lc
-    cat_ok = ((lc >= hp.min_data_in_leaf) & (rc >= hp.min_data_in_leaf) &
-              (lh >= hp.min_sum_hessian_in_leaf) &
-              (rh >= hp.min_sum_hessian_in_leaf))
-    cat_gain_shift = leaf_gain(parent_grad, parent_hess, l1, cl2,
-                               hp.max_delta_step)
-    cat_gain = (leaf_gain(lg, lh, l1, cl2, hp.max_delta_step, hp.path_smooth,
-                          lc, parent_output[:, None, None]) +
-                leaf_gain(rg, rh, l1, cl2, hp.max_delta_step, hp.path_smooth,
-                          rc, parent_output[:, None, None]))
-    cat_min_shift = (cat_gain_shift + hp.min_gain_to_split)[:, None, None]
-    cat_gain = jnp.where(cat_ok & cat_valid &
-                         (cat_gain > cat_min_shift), cat_gain, -jnp.inf)
+    use_onehot_f = num_bins <= hp.max_cat_to_onehot                # [F]
+    cat_basic_valid = (bins_r[None, None, :] >= 1) & \
+        (bins_r[None, None, :] < num_bins[None, :, None])
+    if hp.has_categorical:
+        po3 = parent_output[:, None, None]
+        # -- one-hot (original l2, feature_histogram.hpp:318-372) --
+        lg, lh, lc = hist[..., 0], hist[..., 1], hist[..., 2]
+        rg = tot[..., 0] - lg
+        rh = tot[..., 1] - lh
+        rc = tot[..., 2] - lc
+        oh_ok = ((lc >= hp.min_data_in_leaf) & (rc >= hp.min_data_in_leaf) &
+                 (lh >= hp.min_sum_hessian_in_leaf) &
+                 (rh >= hp.min_sum_hessian_in_leaf))
+        onehot_gain = (leaf_gain(lg, lh, l1, l2, hp.max_delta_step,
+                                 hp.path_smooth, lc, po3) +
+                       leaf_gain(rg, rh, l1, l2, hp.max_delta_step,
+                                 hp.path_smooth, rc, po3))
+        onehot_gain = jnp.where(oh_ok & cat_basic_valid, onehot_gain,
+                                -jnp.inf)
+        # -- sorted two-direction scan (l2 + cat_l2) --
+        cnt3 = hist[..., 2]
+        sort_ok = cat_basic_valid & (cnt3 >= hp.cat_smooth)
+        ratio = jnp.where(sort_ok,
+                          hist[..., 0] / (hist[..., 1] + hp.cat_smooth),
+                          jnp.inf)
+        used_bin = jnp.sum(sort_ok, axis=2)                        # [S,F]
+        max_num_cat = jnp.minimum(hp.max_cat_threshold,
+                                  (used_bin + 1) // 2)             # [S,F]
+        pos_limit = jnp.minimum(used_bin, max_num_cat)[:, :, None]
+        min_rc = max(hp.min_data_in_leaf, hp.min_data_per_group)
+
+        def scan_dir(order):
+            sh = jnp.take_along_axis(hist, order[..., None], axis=2)
+            sp = jnp.cumsum(sh, axis=2)                            # [S,F,B,3]
+            slg, slh, slc = sp[..., 0], sp[..., 1], sp[..., 2]
+            srg = tot[..., 0] - slg
+            srh = tot[..., 1] - slh
+            src = tot[..., 2] - slc
+            ok = ((bins_r[None, None, :] < pos_limit) &
+                  (slc >= hp.min_data_in_leaf) &
+                  (slh >= hp.min_sum_hessian_in_leaf) &
+                  (src >= min_rc) & (srh >= hp.min_sum_hessian_in_leaf))
+            g = (leaf_gain(slg, slh, l1, cl2, hp.max_delta_step,
+                           hp.path_smooth, slc, po3) +
+                 leaf_gain(srg, srh, l1, cl2, hp.max_delta_step,
+                           hp.path_smooth, src, po3))
+            return jnp.where(ok, g, -jnp.inf), sp
+
+        order_a = jnp.argsort(ratio, axis=2)
+        order_d = jnp.argsort(jnp.where(sort_ok, -ratio, jnp.inf), axis=2)
+        gain_a, sp_a = scan_dir(order_a)
+        gain_d, sp_d = scan_dir(order_d)
+        sorted_gain = jnp.maximum(gain_a, gain_d)
+        cat_dir_bwd = gain_d > gain_a                              # [S,F,B]
+        cat_gain = jnp.where(use_onehot_f[None, :, None], onehot_gain,
+                             sorted_gain)
+        cat_gain = jnp.where(
+            is_cat[None, :, None] & (fmask[:, :, None] > 0) &
+            (cat_gain > min_gain_shift[:, None, None]), cat_gain, -jnp.inf)
+    else:
+        cat_gain = jnp.full((s, f, b), -jnp.inf)
+        cat_dir_bwd = jnp.zeros((s, f, b), bool)
+        sp_a = sp_d = None
+        order_a = order_d = None
 
     # ---------- combine & argmax ----------
     num_gain = jnp.maximum(gain_na_right, gain_na_left)
@@ -258,15 +312,47 @@ def find_best_splits(hist: jax.Array, parent_grad: jax.Array,
     sel = (jnp.arange(s), best_f, best_t)
     chose_na_left = gain_na_left[sel] >= gain_na_right[sel]
     best_is_cat = is_cat[best_f]
-    left = jnp.where(
-        best_is_cat[:, None], hist[sel],
-        jnp.where(chose_na_left[:, None], (prefix + nan_sums)[sel],
-                  prefix[sel]))                                    # [S, 3]
+    num_left = jnp.where(chose_na_left[:, None], (prefix + nan_sums)[sel],
+                         prefix[sel])                              # [S, 3]
+    w = (b + 31) // 32
+    if hp.has_categorical:
+        use_oh = use_onehot_f[best_f]                              # [S]
+        dir_bwd = cat_dir_bwd[sel]                                 # [S]
+        sorted_left = jnp.where(dir_bwd[:, None], sp_d[sel], sp_a[sel])
+        cat_left = jnp.where(use_oh[:, None], hist[sel], sorted_left)
+        left = jnp.where(best_is_cat[:, None], cat_left, num_left)
+        # best one-hot split uses original l2; sorted uses l2 + cat_l2
+        # (feature_histogram.hpp:384,476-489)
+        eff_l2 = jnp.where(best_is_cat & ~use_oh, cl2, l2)
+        # bin bitset of the left set: one-hot -> {best_t}; sorted -> the
+        # first best_t+1 bins in the winning scan direction. Only the best
+        # feature's row per slot is needed, so gather the [S, B] permutation
+        # first and invert that (not the full [S, F, B] orders).
+        order_sel = jnp.where(
+            dir_bwd[:, None],
+            order_d[jnp.arange(s), best_f], order_a[jnp.arange(s), best_f])
+        rank_sel = jnp.zeros((s, b), jnp.int32).at[
+            jnp.arange(s)[:, None], order_sel].set(
+            jnp.broadcast_to(bins_r[None, :], (s, b)))  # bin -> sorted pos
+        member_sorted = rank_sel <= best_t[:, None]                # [S, B]
+        member_oh = bins_r[None, :] == best_t[:, None]
+        member = best_is_cat[:, None] & jnp.where(
+            use_oh[:, None], member_oh, member_sorted)
+        pad = w * 32 - b
+        member_p = jnp.pad(member, ((0, 0), (0, pad))) if pad else member
+        weights = jnp.left_shift(
+            jnp.uint32(1), jnp.arange(32, dtype=jnp.uint32))
+        cat_bitset = jnp.sum(
+            member_p.reshape(s, w, 32).astype(jnp.uint32) *
+            weights[None, None, :], axis=2, dtype=jnp.uint32)      # [S, W]
+    else:
+        left = num_left
+        eff_l2 = l2
+        cat_bitset = jnp.zeros((s, w), jnp.uint32)
     lgs, lhs, lcs = left[..., 0], left[..., 1], left[..., 2]
     rgs = parent_grad - lgs
     rhs = parent_hess - lhs
     rcs = parent_count - lcs
-    eff_l2 = jnp.where(best_is_cat, cl2, l2)
     lout = leaf_output(lgs, lhs, l1, eff_l2, hp.max_delta_step,
                        hp.path_smooth, lcs, parent_output)
     rout = leaf_output(rgs, rhs, l1, eff_l2, hp.max_delta_step,
@@ -274,18 +360,16 @@ def find_best_splits(hist: jax.Array, parent_grad: jax.Array,
     if hp.has_monotone:
         lout = jnp.clip(lout, cons_min, cons_max)
         rout = jnp.clip(rout, cons_min, cons_max)
-    shift = jnp.where(best_is_cat, cat_gain_shift, gain_shift)
 
-    # per-feature best gain (minus the feature's gain shift) for voting
-    pf_shift = jnp.where(is_cat[None, :], cat_gain_shift[:, None],
-                         gain_shift[:, None])                      # [S, F]
-    per_feature_gain = jnp.max(all_gain, axis=2) - pf_shift        # [S, F]
+    # per-feature best gain (minus the gain shift) for voting
+    per_feature_gain = jnp.max(all_gain, axis=2) - gain_shift[:, None]
 
     return BestSplits(
-        gain=jnp.where(has_split, best_gain - shift, -jnp.inf),
+        gain=jnp.where(has_split, best_gain - gain_shift, -jnp.inf),
         feature=jnp.where(has_split, best_f, -1),
         threshold_bin=best_t,
         default_left=jnp.where(best_is_cat, False, chose_na_left),
         left_grad=lgs, left_hess=lhs, left_count=lcs,
         left_output=lout, right_output=rout,
-        per_feature_gain=per_feature_gain)
+        per_feature_gain=per_feature_gain,
+        cat_bitset=cat_bitset)

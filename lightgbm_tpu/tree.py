@@ -593,6 +593,7 @@ def host_tree_from_arrays(tarr, used_to_orig: Optional[np.ndarray],
     thr_bin = np.asarray(tarr.threshold_bin)[:nn]
     default_left = np.asarray(tarr.default_left)[:nn]
     is_cat = np.asarray(tarr.is_cat)[:nn]
+    cat_bitsets = np.asarray(tarr.cat_bitset)[:nn]
     value = np.asarray(tarr.leaf_value)[:nn]
     sum_hess = np.asarray(tarr.sum_hess)[:nn]
     count = np.asarray(tarr.count)[:nn]
@@ -624,13 +625,22 @@ def host_tree_from_arrays(tarr, used_to_orig: Optional[np.ndarray],
         t_right[r] = child_ref(int(right[nid]))
         m = mappers[fu] if mappers is not None else None
         if is_cat[nid]:
-            # one-hot set {category}; bitset over category values
-            b = int(thr_bin[nid])
-            catval = m.bin_2_categorical[b] if m is not None else b
-            catval = max(int(catval), 0)
-            nwords = catval // 32 + 1
+            # decode the node's bin bitset -> category-value bitset
+            # (reference SplitInfo::cat_threshold -> Tree cat storage,
+            # tree.h:25 cat_boundaries_/cat_threshold_)
+            words_bins = cat_bitsets[nid]
+            catvals = []
+            for b in range(len(words_bins) * 32):
+                if (int(words_bins[b // 32]) >> (b % 32)) & 1:
+                    catval = m.bin_2_categorical[b] if m is not None and \
+                        b < len(m.bin_2_categorical) else b
+                    catvals.append(max(int(catval), 0))
+            if not catvals:
+                catvals = [0]
+            nwords = max(catvals) // 32 + 1
             words = [0] * nwords
-            words[catval // 32] |= (1 << (catval % 32))
+            for catval in catvals:
+                words[catval // 32] |= (1 << (catval % 32))
             t_threshold[r] = len(cat_boundaries) - 1
             cat_boundaries.append(cat_boundaries[-1] + nwords)
             cat_threshold.extend(words)

@@ -70,6 +70,65 @@ def test_cli_convert_model(example_dir):
     assert "double Predict(" in code
 
 
+def _run_generated_cpp(tmp_path, cpp_path, X):
+    """Compile the generated if-else model and run it over rows of X."""
+    import subprocess
+    n, f = X.shape
+    main_src = f"""
+#include <cstdio>
+#include "model.cpp"
+int main() {{
+  double arr[{f}];
+  while (std::scanf("%lf", &arr[0]) == 1) {{
+    for (int j = 1; j < {f}; ++j) std::scanf("%lf", &arr[j]);
+    std::printf("%.17g\\n", Predict(arr));
+  }}
+  return 0;
+}}
+"""
+    (tmp_path / "main.cpp").write_text(main_src)
+    exe = tmp_path / "model_exe"
+    subprocess.run(["g++", "-O1", "-o", str(exe),
+                    str(tmp_path / "main.cpp")],
+                   check=True, cwd=tmp_path)
+    feed = "\n".join(" ".join(f"{v:.17g}" for v in row) for row in X)
+    out = subprocess.run([str(exe)], input=feed, text=True,
+                         capture_output=True, check=True)
+    return np.asarray([float(t) for t in out.stdout.split()])
+
+
+@pytest.mark.skipif(__import__("shutil").which("g++") is None,
+                    reason="g++ not available")
+def test_cli_convert_model_cpp_matches_predict(tmp_path):
+    """Generated C++ reproduces raw scores, incl. categorical bitset
+    splits and NaN default directions (reference SaveModelToIfElse,
+    gbdt_model_text.cpp:286)."""
+    import lightgbm_tpu as lgb
+    r = np.random.RandomState(3)
+    n = 1500
+    cat = r.randint(0, 10, n).astype(np.float64)
+    x1 = r.randn(n)
+    y = ((cat.astype(int) % 3 == 0) ^ (x1 > 0.4)).astype(np.float32)
+    X = np.column_stack([cat, x1])
+    X[r.rand(n) < 0.05, 1] = np.nan  # exercise NaN default direction
+    bst = lgb.train({"objective": "binary", "num_leaves": 8,
+                     "max_cat_to_onehot": 4, "min_data_in_leaf": 5,
+                     "verbosity": -1},
+                    lgb.Dataset(X, label=y, categorical_feature=[0]), 10)
+    model_file = tmp_path / "model.txt"
+    bst.save_model(str(model_file))
+    main(["task=convert_model", f"input_model={model_file}",
+          f"convert_model={tmp_path}/model.cpp"])
+    Xt = np.column_stack([r.randint(0, 12, 300).astype(np.float64),
+                          r.randn(300)])
+    Xt[r.rand(300) < 0.1, 1] = np.nan
+    # hostile categorical values: negative, inf, huge — all route right
+    Xt[:4, 0] = [-0.5, np.inf, 3e9, -7.0]
+    got = _run_generated_cpp(tmp_path, tmp_path / "model.cpp", Xt)
+    want = bst.predict(Xt, raw_score=True)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
 def test_cli_refit(example_dir):
     main([f"config={example_dir}/train.conf"])
     main([f"task=refit", f"data={example_dir}/train.tsv",
