@@ -75,6 +75,24 @@ class Dataset:
         cfg = param_dict_to_config(self.params)
         data = self.data
         if isinstance(data, str):
+            if BinnedDataset.is_binary_file(data):
+                # binary fast path (reference LoadFromBinFile,
+                # dataset_loader.cpp:274): skip parsing + bin finding;
+                # constructor-arg metadata overrides what the cache stored
+                self._binned = BinnedDataset.load_binary(data)
+                md = self._binned.metadata
+                self._binned.metadata = Metadata(
+                    self._binned.num_data,
+                    label=self.label if self.label is not None else md.label,
+                    weight=self.weight if self.weight is not None
+                    else md.weight,
+                    group=np.asarray(self.group) if self.group is not None
+                    else md.query_boundaries,
+                    init_score=self.init_score
+                    if self.init_score is not None else md.init_score)
+                if self.free_raw_data:
+                    self.data = None
+                return self
             raw = _load_svmlight_or_csv(data)
             if self.label is None:
                 self.label, raw = raw[:, 0], raw[:, 1:]
@@ -208,6 +226,13 @@ class Dataset:
         sub._binned = self._binned.subset(np.asarray(used_indices))
         sub.reference = self
         return sub
+
+    def save_binary(self, filename: str) -> "Dataset":
+        """Write the constructed dataset to a binary cache file
+        (reference basic.py Dataset.save_binary / LGBM_DatasetSaveBinary)."""
+        self.construct()
+        self._binned.save_binary(filename)
+        return self
 
     @property
     def binned(self) -> BinnedDataset:

@@ -126,19 +126,28 @@ class Application:
             self.refit()
         elif task == "convert_model":
             self.convert_model()
+        elif task == "save_binary":
+            self.save_binary()
         else:
             Log.fatal("Unknown task %s", task)
 
     # ------------------------------------------------------------------
+    def _load_train_dataset(self) -> Dataset:
+        cfg = self.config
+        from .data import BinnedDataset
+        if BinnedDataset.is_binary_file(cfg.data):
+            return Dataset(cfg.data, params=dict(self.params))
+        X, y = _load_text_data(cfg.data, cfg)
+        group = _maybe_load_group(cfg.data)
+        weight = _maybe_load_weight(cfg.data)
+        return Dataset(X, label=y, group=group, weight=weight,
+                       params=dict(self.params))
+
     def train(self) -> None:
         cfg = self.config
         if not cfg.data:
             Log.fatal("No training data: set data=<file>")
-        X, y = _load_text_data(cfg.data, cfg)
-        group = _maybe_load_group(cfg.data)
-        weight = _maybe_load_weight(cfg.data)
-        dtrain = Dataset(X, label=y, group=group, weight=weight,
-                         params=dict(self.params))
+        dtrain = self._load_train_dataset()
         valid_sets, valid_names = [], []
         if cfg.valid:
             for i, vpath in enumerate(str(cfg.valid).split(",")):
@@ -184,6 +193,17 @@ class Application:
         new_booster = booster.refit(X, y, decay_rate=cfg.refit_decay_rate)
         new_booster.save_model(cfg.output_model)
         Log.info("Finished refit, model saved to %s", cfg.output_model)
+
+    def save_binary(self) -> None:
+        """task=save_binary: quantize the data once, cache to <data>.bin
+        (reference application.cpp save_binary task)."""
+        cfg = self.config
+        if not cfg.data:
+            Log.fatal("No training data: set data=<file>")
+        dtrain = self._load_train_dataset()
+        out = cfg.data + ".bin"
+        dtrain.save_binary(out)
+        Log.info("Dataset saved to binary file %s", out)
 
     def convert_model(self) -> None:
         cfg = self.config

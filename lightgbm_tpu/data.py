@@ -183,3 +183,71 @@ class BinnedDataset:
         return BinnedDataset(self.bins[row_indices], self.mappers,
                              self.used_features, self.num_total_features,
                              sub_md, self.feature_names)
+
+    # ---- binary cache -------------------------------------------------
+    # Reference: Dataset::SaveBinaryFile / DatasetLoader::LoadFromBinFile
+    # (dataset.cpp binary token path, dataset_loader.cpp:274) — skips text
+    # parsing and bin finding entirely on reload.
+    _BINARY_MAGIC = "lightgbm_tpu.dataset.v1"
+
+    def save_binary(self, filename: str) -> None:
+        """Serialize the quantized matrix + bin mappers + metadata."""
+        import json
+        md = self.metadata
+        mapper_json = json.dumps([m.to_dict() for m in self.mappers])
+        payload = dict(
+            magic=np.frombuffer(
+                self._BINARY_MAGIC.encode(), dtype=np.uint8),
+            bins=self.bins,
+            used_features=self.used_features,
+            num_total_features=np.int64(self.num_total_features),
+            feature_names=np.array([str(s) for s in self.feature_names]),
+            mappers_json=np.frombuffer(
+                mapper_json.encode(), dtype=np.uint8),
+        )
+        for fld in ("label", "weight", "init_score"):
+            v = getattr(md, fld)
+            if v is not None:
+                payload["md_" + fld] = v
+        if md.query_boundaries is not None:
+            payload["md_query_boundaries"] = md.query_boundaries
+        with open(filename, "wb") as fh:
+            np.savez_compressed(fh, **payload)
+
+    @staticmethod
+    def is_binary_file(filename: str) -> bool:
+        try:
+            with open(filename, "rb") as fh:
+                if fh.read(4) != b"PK\x03\x04":
+                    return False
+            with np.load(filename) as z:
+                if "magic" not in z:
+                    return False
+                return bytes(z["magic"]).decode() == \
+                    BinnedDataset._BINARY_MAGIC
+        except Exception:
+            return False
+
+    @staticmethod
+    def load_binary(filename: str) -> "BinnedDataset":
+        import json
+        from .binning import BinMapper
+        with np.load(filename) as z:
+            if bytes(z["magic"]).decode() != BinnedDataset._BINARY_MAGIC:
+                raise ValueError(f"{filename} is not a lightgbm_tpu "
+                                 "binary dataset")
+            mappers = [BinMapper.from_dict(d) for d in
+                       json.loads(bytes(z["mappers_json"]).decode())]
+            bins = z["bins"]
+            md = Metadata(
+                int(bins.shape[0]),
+                label=z["md_label"] if "md_label" in z else None,
+                weight=z["md_weight"] if "md_weight" in z else None,
+                group=z["md_query_boundaries"]
+                if "md_query_boundaries" in z else None,
+                init_score=z["md_init_score"]
+                if "md_init_score" in z else None)
+            return BinnedDataset(
+                bins, mappers, z["used_features"],
+                int(z["num_total_features"]), md,
+                [str(s) for s in z["feature_names"]])

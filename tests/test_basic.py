@@ -193,3 +193,47 @@ class TestDatasetAPI:
         d = lgb.Dataset(X, label=np.zeros(100))
         d.set_weight(np.ones(100))
         assert d.get_field("weight") is not None
+
+
+class TestBinaryCache:
+    """Dataset binary save/load (reference save_binary task +
+    LoadFromBinFile fast path, dataset_loader.cpp:274)."""
+
+    def test_roundtrip_identical(self, tmp_path):
+        rng = np.random.RandomState(3)
+        X = rng.randn(400, 6)
+        X[rng.rand(400, 6) < 0.1] = np.nan
+        X[:, 2] = rng.randint(0, 5, 400)
+        y = (X[:, 0] > 0).astype(np.float32)
+        w = rng.rand(400).astype(np.float32)
+        d = lgb.Dataset(X, label=y, weight=w,
+                        categorical_feature=[2])
+        path = str(tmp_path / "data.bin")
+        d.save_binary(path)
+        assert BinnedDataset.is_binary_file(path)
+        assert not BinnedDataset.is_binary_file(__file__)
+        d2 = lgb.Dataset(path)
+        d2.construct()
+        b1, b2 = d.binned, d2.binned
+        np.testing.assert_array_equal(b1.bins, b2.bins)
+        np.testing.assert_array_equal(b1.used_features, b2.used_features)
+        np.testing.assert_array_equal(b1.num_bins, b2.num_bins)
+        np.testing.assert_array_equal(b1.metadata.label, b2.metadata.label)
+        np.testing.assert_array_equal(b1.metadata.weight, b2.metadata.weight)
+        for m1, m2 in zip(b1.mappers, b2.mappers):
+            np.testing.assert_array_equal(m1.bin_upper_bound,
+                                          m2.bin_upper_bound)
+            assert m1.bin_2_categorical == m2.bin_2_categorical
+            assert m1.missing_type == m2.missing_type
+
+    def test_train_from_binary_matches(self, tmp_path):
+        rng = np.random.RandomState(4)
+        X = rng.randn(500, 5)
+        y = (X[:, 0] + X[:, 1] ** 2 > 0.5).astype(np.float32)
+        params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+                  "num_iterations": 10}
+        b1 = lgb.train(params, lgb.Dataset(X, label=y))
+        path = str(tmp_path / "t.bin")
+        lgb.Dataset(X, label=y).save_binary(path)
+        b2 = lgb.train(params, lgb.Dataset(path))
+        np.testing.assert_allclose(b1.predict(X), b2.predict(X), rtol=1e-6)

@@ -135,3 +135,25 @@ def test_cli_refit(example_dir):
           f"input_model={example_dir}/model.txt",
           f"output_model={example_dir}/model_refit.txt"])
     assert (example_dir / "model_refit.txt").exists()
+
+
+def test_cli_save_binary_then_train(example_dir):
+    """task=save_binary writes <data>.bin; training from it matches text
+    (reference application.cpp save_binary task + binary fast path)."""
+    conf = example_dir / "savebin.conf"
+    conf.write_text(f"""
+task = save_binary
+data = {example_dir}/train.tsv
+verbosity = -1
+""")
+    main([f"config={conf}"])
+    bin_path = example_dir / "train.tsv.bin"
+    assert bin_path.exists()
+    main([f"config={example_dir}/train.conf"])
+    preds_text = (example_dir / "model.txt").read_text()
+    main([f"config={example_dir}/train.conf", f"data={bin_path}",
+          "valid=", f"output_model={example_dir}/model_bin.txt"])
+    preds_bin = (example_dir / "model_bin.txt").read_text()
+    # identical trees; only the echoed parameters block may differ (paths)
+    assert preds_text.split("\nparameters")[0] == \
+        preds_bin.split("\nparameters")[0]
