@@ -80,6 +80,26 @@ class Dataset:
                 # dataset_loader.cpp:274): skip parsing + bin finding;
                 # constructor-arg metadata overrides what the cache stored
                 self._binned = BinnedDataset.load_binary(data)
+                if self.reference is not None:
+                    # a cached valid set must share the training dataset's
+                    # bin mappers (reference Dataset::CheckAlign via
+                    # LGBM_BoosterAddValidData: "different bin mappers
+                    # with training data")
+                    self.reference.construct()
+                    ref = self.reference._binned
+                    same = (
+                        ref.num_total_features ==
+                        self._binned.num_total_features and
+                        np.array_equal(ref.used_features,
+                                       self._binned.used_features) and
+                        all(a.to_dict() == b.to_dict() for a, b in
+                            zip(ref.mappers, self._binned.mappers)))
+                    if not same:
+                        raise ValueError(
+                            "Cannot use binary dataset file as validation "
+                            "data: it has different bin mappers than the "
+                            "training data. Re-save it with "
+                            "reference=<train dataset>.")
                 md = self._binned.metadata
                 self._binned.metadata = Metadata(
                     self._binned.num_data,
@@ -395,12 +415,19 @@ class Booster:
     def predict(self, data, start_iteration: int = 0,
                 num_iteration: Optional[int] = None, raw_score: bool = False,
                 pred_leaf: bool = False, pred_contrib: bool = False,
-                validate_features: bool = False, **kwargs) -> np.ndarray:
+                validate_features: bool = False,
+                pred_early_stop: bool = False,
+                pred_early_stop_freq: int = 10,
+                pred_early_stop_margin: float = 10.0,
+                **kwargs) -> np.ndarray:
         model = self._host_model()
         X = _to_2d_float(data)
         return model.predict(X, start_iteration=start_iteration,
                              num_iteration=num_iteration, raw_score=raw_score,
-                             pred_leaf=pred_leaf, pred_contrib=pred_contrib)
+                             pred_leaf=pred_leaf, pred_contrib=pred_contrib,
+                             pred_early_stop=pred_early_stop,
+                             pred_early_stop_freq=pred_early_stop_freq,
+                             pred_early_stop_margin=pred_early_stop_margin)
 
     def refit(self, data, label, decay_rate: Optional[float] = None,
               **kwargs) -> "Booster":

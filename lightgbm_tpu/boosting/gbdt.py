@@ -110,6 +110,8 @@ class GBDT:
                     orig2used[int(fi)] for fi in grp
                     if int(fi) in orig2used)))
             self._interaction_groups = tuple(g for g in groups if g)
+        self._forced = self._load_forced_splits(cfg, ds)
+        self._setup_cegb(cfg, ds)
         self.hp = SplitHyperParams(
             lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
             min_gain_to_split=cfg.min_gain_to_split,
@@ -126,6 +128,15 @@ class GBDT:
             extra_trees=cfg.extra_trees,
             has_categorical=bool(np.any(ds.is_categorical)))
         self._setup_parallel(cfg)
+        if self._forced is not None and self._grower is not None:
+            Log.warning("forced splits are not supported with distributed "
+                        "tree learners yet; ignoring forcedsplits_filename")
+            self._forced = None
+        if self._cegb_cfg is not None and self._grower is not None:
+            Log.warning("CEGB penalties are not supported with distributed "
+                        "tree learners yet; ignoring cegb_* parameters")
+            self._cegb_cfg = None
+            self._cegb_state = None
         # Pallas MXU histogram kernel on TPU-like backends (serial learner;
         # the sharded path keeps the portable scatter fallback for now)
         backend = jax.default_backend()
@@ -138,6 +149,92 @@ class GBDT:
         self._boosted_from_average = [False] * k
         if self.objective is not None:
             self.objective.init(ds.metadata, ds.num_data)
+
+    def _setup_cegb(self, cfg, ds) -> None:
+        """Cost-effective gradient boosting penalties (reference
+        cost_effective_gradient_boosting.hpp:23)."""
+        self._cegb_cfg = None
+        self._cegb_state = None
+        lazy = cfg.cegb_penalty_feature_lazy
+        coupled = cfg.cegb_penalty_feature_coupled
+        has_lazy = bool(lazy)
+        has_coupled = bool(coupled)
+        if cfg.cegb_penalty_split <= 0 and not has_lazy and not has_coupled:
+            return
+        from ..learner.grower import CegbParams
+        f = ds.num_features
+        used = np.asarray(ds.used_features, np.int64)
+
+        def _per_used(pen):
+            arr = np.zeros(ds.num_total_features, np.float32)
+            pen = np.asarray(pen, np.float32)
+            arr[:len(pen)] = pen
+            return jnp.asarray(arr[used])
+
+        self._cegb_cfg = CegbParams(
+            tradeoff=float(cfg.cegb_tradeoff),
+            penalty_split=float(cfg.cegb_penalty_split),
+            has_coupled=has_coupled, has_lazy=has_lazy)
+        self._cegb_state = (
+            _per_used(coupled) if has_coupled else jnp.zeros(f, jnp.float32),
+            _per_used(lazy) if has_lazy else jnp.zeros(f, jnp.float32),
+            jnp.zeros(f, bool),
+            jnp.zeros((ds.num_data, f) if has_lazy else (1, 1), bool))
+
+    @staticmethod
+    def _load_forced_splits(cfg, ds):
+        """Flatten the forced-splits JSON tree (reference ForceSplits,
+        serial_tree_learner.cpp:459; JSON read at serial_tree_learner.cpp:53)
+        into spec arrays (feature, threshold bin, left/right spec idx)."""
+        fname = getattr(cfg, "forcedsplits_filename", "")
+        if not fname:
+            return None
+        import json
+        with open(fname) as fh:
+            root = json.load(fh)
+        if not root:
+            return None
+        orig2used = {int(o): j for j, o in enumerate(ds.used_features)}
+        feat, tbin, left, right = [], [], [], []
+        nodes = [root]          # BFS; spec idx = position in this list
+        i = 0
+        while i < len(nodes):
+            nd = nodes[i]
+            fo = int(nd["feature"])
+            if fo not in orig2used:
+                Log.warning("forced split on unused feature %d ignored", fo)
+                feat.append(-1)
+                tbin.append(0)
+                left.append(-1)
+                right.append(-1)
+                i += 1
+                continue
+            fu = orig2used[fo]
+            mapper = ds.mappers[fu]
+            if mapper.is_categorical:
+                Log.warning("forced split on categorical feature %d ignored "
+                            "(numerical thresholds only)", fo)
+                feat.append(-1)
+                tbin.append(0)
+                left.append(-1)
+                right.append(-1)
+                i += 1
+                continue
+            feat.append(fu)
+            tbin.append(int(mapper._value_to_bin_scalar(
+                float(nd["threshold"]))))
+            for key, out in (("left", left), ("right", right)):
+                child = nd.get(key)
+                if child:
+                    nodes.append(child)
+                    out.append(len(nodes) - 1)
+                else:
+                    out.append(-1)
+            i += 1
+        if not feat or all(f < 0 for f in feat):
+            return None
+        return (jnp.asarray(feat, jnp.int32), jnp.asarray(tbin, jnp.int32),
+                jnp.asarray(left, jnp.int32), jnp.asarray(right, jnp.int32))
 
     def _setup_parallel(self, cfg) -> None:
         """Distributed learner setup (reference CreateTreeLearner crossbar,
@@ -185,7 +282,7 @@ class GBDT:
             jax.random.PRNGKey(cfg.extra_seed), self.iter_) \
             if needs_rng else None
         if self._grower is None:
-            return grow_tree(
+            out = grow_tree(
                 self.bins, g, h, cnt, feature_mask, self.num_bins_d,
                 self.missing_is_nan_d, self.is_cat_d,
                 num_leaves=cfg.num_leaves,
@@ -194,7 +291,17 @@ class GBDT:
                 monotone=self._monotone,
                 interaction_groups=self._interaction_groups,
                 feature_fraction_bynode=cfg.feature_fraction_bynode,
-                rng_key=rng_key, hist_impl=self._hist_impl)
+                rng_key=rng_key, hist_impl=self._hist_impl,
+                forced=self._forced, cegb_cfg=self._cegb_cfg,
+                cegb_state=self._cegb_state)
+            if self._cegb_cfg is not None:
+                tree, row_node, (fu, rfu) = out
+                # feature-used flags persist across the whole model
+                # (is_feature_used_in_split_ / is_feature_used_)
+                self._cegb_state = (self._cegb_state[0],
+                                    self._cegb_state[1], fu, rfu)
+                return tree, row_node
+            return out
         if self._row_pad:
             g = jnp.pad(g, (0, self._row_pad))
             h = jnp.pad(h, (0, self._row_pad))

@@ -178,7 +178,10 @@ class Application:
             pred_leaf=cfg.predict_leaf_index,
             pred_contrib=cfg.predict_contrib,
             start_iteration=cfg.start_iteration_predict,
-            num_iteration=cfg.num_iteration_predict)
+            num_iteration=cfg.num_iteration_predict,
+            pred_early_stop=cfg.pred_early_stop,
+            pred_early_stop_freq=cfg.pred_early_stop_freq,
+            pred_early_stop_margin=cfg.pred_early_stop_margin)
         out = np.asarray(pred)
         if out.ndim == 1:
             out = out[:, None]

@@ -34,9 +34,20 @@ import jax.numpy as jnp
 
 from ..parallel.comm import CommSpec
 from .histogram import build_histograms
-from .split import BestSplits, SplitHyperParams, find_best_splits, leaf_output
+from .split import (BestSplits, SplitHyperParams, _split_gain,
+                    find_best_splits, leaf_gain, leaf_output)
 
-__all__ = ["TreeArrays", "grow_tree"]
+__all__ = ["CegbParams", "TreeArrays", "grow_tree"]
+
+
+@dataclasses.dataclass(frozen=True)
+class CegbParams:
+    """Static CEGB settings (reference Config cegb_* params,
+    cost_effective_gradient_boosting.hpp:23)."""
+    tradeoff: float = 1.0
+    penalty_split: float = 0.0
+    has_coupled: bool = False
+    has_lazy: bool = False
 
 
 class TreeArrays(NamedTuple):
@@ -71,6 +82,10 @@ class _GrowState(NamedTuple):
     slot_of_node: jax.Array    # [M+1] i32, -1 = not in frontier this pass
     slot_nodes: jax.Array      # [S] i32 node id per slot; M = inactive
     best: BestSplits           # per-NODE arrays [M+1]
+    node_force: jax.Array      # [M+1] forced-split spec idx per node (-1=none)
+    forced_ok: jax.Array       # [M+1] forced split of node is applicable
+    feat_used: jax.Array       # [F] feature used by any model split (CEGB)
+    row_feat_used: jax.Array   # [N, F] row charged for feature (CEGB lazy)
     cons_min: jax.Array        # [M+1] monotone lower bound per node
     cons_max: jax.Array        # [M+1] monotone upper bound per node
     path_mask: jax.Array       # [M+1, F] features used on root path (or [1,1])
@@ -121,7 +136,7 @@ def _merge_gathered_best(gathered: BestSplits) -> BestSplits:
     static_argnames=("num_leaves", "max_depth", "hp", "leafwise", "bmax",
                      "feature_block", "max_passes", "comm",
                      "interaction_groups", "feature_fraction_bynode",
-                     "hist_impl"))
+                     "hist_impl", "cegb_cfg"))
 def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               cnt_weight: jax.Array, feature_mask: jax.Array,
               num_bins: jax.Array, missing_is_nan: jax.Array,
@@ -133,8 +148,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               interaction_groups: Optional[tuple] = None,
               feature_fraction_bynode: float = 1.0,
               rng_key: Optional[jax.Array] = None,
-              hist_impl: str = "scatter"
-              ) -> Tuple[TreeArrays, jax.Array]:
+              hist_impl: str = "scatter",
+              forced: Optional[Tuple[jax.Array, jax.Array, jax.Array,
+                                     jax.Array]] = None,
+              cegb_cfg: Optional[CegbParams] = None,
+              cegb_state: Optional[Tuple[jax.Array, jax.Array, jax.Array]]
+              = None):
     """Grow one tree. grad/hess must already include bagging/objective
     weights (zeros for out-of-bag rows); `cnt_weight` is 1.0 for in-bag rows
     and 0.0 otherwise so min_data_in_leaf counts sampled rows only.
@@ -205,12 +224,40 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     use_bynode = feature_fraction_bynode < 1.0 and rng_key is not None
     k_bynode = max(1, int(round(feature_fraction_bynode * f)))
 
+    # Forced splits (reference SerialTreeLearner::ForceSplits,
+    # serial_tree_learner.cpp:459): `forced` carries a flattened spec tree
+    # (feature [K], threshold bin [K], left/right child spec idx [K]); the
+    # root node is bound to spec 0 and children inherit the spec's subtree
+    # indices, reproducing the reference's BFS application order (forced
+    # nodes outrank every gain-chosen split in the selection step).
+    use_forced = forced is not None
+    if use_forced:
+        forced_feat, forced_bin, forced_left, forced_right = forced
+        n_spec = forced_feat.shape[0]
+
+    # CEGB (cost_effective_gradient_boosting.hpp): per-(node, feature) gain
+    # penalty = tradeoff * (penalty_split * n_leaf
+    #   + coupled[f] * [f unused in model]
+    #   + lazy[f] * #in-bag rows in leaf not yet charged for f)
+    use_cegb = cegb_cfg is not None
+    if use_cegb:
+        # (coupled [F], lazy [F], feat_used [F] bool, row_feat_used [N,F])
+        cegb_coupled, cegb_lazy, feat_used0, row_feat_used0 = cegb_state
+    else:
+        feat_used0 = jnp.zeros(1, bool)
+        row_feat_used0 = jnp.zeros((1, 1), bool)
+
     state = _GrowState(
         tree=tree,
         row_node=jnp.zeros(n, jnp.int32),
         slot_of_node=jnp.full(m + 1, -1, jnp.int32).at[0].set(0),
         slot_nodes=jnp.full(s, m, jnp.int32).at[0].set(0),
         best=best0,
+        node_force=(jnp.full(m + 1, -1, jnp.int32).at[0].set(0) if use_forced
+                    else jnp.full(1, -1, jnp.int32)),
+        forced_ok=jnp.zeros(m + 1 if use_forced else 1, bool),
+        feat_used=feat_used0,
+        row_feat_used=row_feat_used0,
         cons_min=jnp.full(m + 1, -jnp.inf, jnp.float32),
         cons_max=jnp.full(m + 1, jnp.inf, jnp.float32),
         path_mask=path_mask0,
@@ -258,9 +305,23 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             kr = jax.random.fold_in(jax.random.fold_in(rng_key, 7919),
                                     st.pass_idx)
             rand_bins = jax.random.randint(kr, (s, f), 0, bmax)
+        if use_cegb:
+            gp = cegb_cfg.tradeoff * cegb_cfg.penalty_split * \
+                tree.count[sn][:, None] * jnp.ones((s, f), jnp.float32)
+            if cegb_cfg.has_coupled:
+                gp += cegb_cfg.tradeoff * cegb_coupled[None, :] * \
+                    (~st.feat_used)[None, :].astype(jnp.float32)
+            if cegb_cfg.has_lazy:
+                rs = jnp.where(row_slot < 0, s, row_slot)
+                uncharged = jnp.zeros((s + 1, f), jnp.float32).at[rs].add(
+                    (~st.row_feat_used).astype(jnp.float32) *
+                    cnt_weight[:, None])[:s]
+                gp += cegb_cfg.tradeoff * cegb_lazy[None, :] * uncharged
+        else:
+            gp = None
         mono_kw = dict(monotone=monotone, cons_min=st.cons_min[sn],
                        cons_max=st.cons_max[sn], depth=tree.depth[sn],
-                       rand_bins=rand_bins)
+                       rand_bins=rand_bins, gain_penalty=gp)
 
         def scan_hist(h, fm):
             return find_best_splits(
@@ -312,6 +373,53 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             hist_sel = hist * sel_mask[:, :, None, None]
             ghist = jax.lax.psum(hist_sel, comm.axis)
             bs = scan_hist(ghist, sel_mask * slot_fmask)
+        if use_forced:
+            # override gain-chosen splits on forced nodes with the spec's
+            # (feature, threshold); stats gathered from the histogram like
+            # FeatureHistogram::GatherInfoForThreshold
+            # (feature_histogram.hpp:862+)
+            nf_slot = st.node_force[sn]                     # [S]
+            has_f = (nf_slot >= 0) & (sn < m)
+            sp = jnp.clip(nf_slot, 0, n_spec - 1)
+            ff = jnp.clip(forced_feat[sp], 0, f - 1)        # [S]
+            fb = forced_bin[sp]
+            hsel = jnp.take_along_axis(
+                hist, ff[:, None, None, None], axis=1)[:, 0]  # [S, B, 3]
+            if rows_sharded:
+                hsel = jax.lax.psum(hsel, comm.axis)
+            lmask = (jnp.arange(hist.shape[2])[None, :] <=
+                     fb[:, None]).astype(hsel.dtype)
+            lg = jnp.sum(hsel[..., 0] * lmask, axis=1)
+            lh = jnp.sum(hsel[..., 1] * lmask, axis=1)
+            lc = jnp.sum(hsel[..., 2] * lmask, axis=1)
+            pg, ph, pc = tree.sum_grad[sn], tree.sum_hess[sn], tree.count[sn]
+            pout = tree.leaf_value[sn]
+            rg_, rh_, rc_ = pg - lg, ph - lh, pc - lc
+            l1, l2 = hp.lambda_l1, hp.lambda_l2
+            shift = leaf_gain(pg, ph, l1, l2, hp.max_delta_step,
+                              hp.path_smooth, pc, pout)
+            fgain = _split_gain(lg, lh, lc, rg_, rh_, rc_, l1, l2, hp,
+                                pout) - shift
+            lout = leaf_output(lg, lh, l1, l2, hp.max_delta_step,
+                               hp.path_smooth, lc, pout)
+            rout = leaf_output(rg_, rh_, l1, l2, hp.max_delta_step,
+                               hp.path_smooth, rc_, pout)
+            valid = has_f & (lc > 0) & (rc_ > 0) & (forced_feat[sp] >= 0)
+            bs = bs._replace(
+                gain=jnp.where(valid, fgain, bs.gain),
+                feature=jnp.where(valid, ff, bs.feature),
+                threshold_bin=jnp.where(valid, fb, bs.threshold_bin),
+                default_left=jnp.where(valid, False, bs.default_left),
+                left_grad=jnp.where(valid, lg, bs.left_grad),
+                left_hess=jnp.where(valid, lh, bs.left_hess),
+                left_count=jnp.where(valid, lc, bs.left_count),
+                left_output=jnp.where(valid, lout, bs.left_output),
+                right_output=jnp.where(valid, rout, bs.right_output),
+                cat_bitset=jnp.where(valid[:, None], jnp.uint32(0),
+                                     bs.cat_bitset))
+            forced_ok = st.forced_ok.at[sn].set(valid).at[m].set(False)
+        else:
+            forced_ok = st.forced_ok
         # scatter slot results into per-node best arrays (dummy -> row m)
         best = BestSplits(*[
             getattr(st.best, fld).at[sn].set(getattr(bs, fld))
@@ -319,9 +427,17 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             for fld in BestSplits._fields])
         # ---- 3. choose splits: top-budget by gain ----
         eligible = tree.is_leaf & jnp.isfinite(best.gain) & (best.gain > 0)
+        if use_forced:
+            # forced nodes split regardless of gain sign/threshold and
+            # outrank all gain-chosen candidates in the top-k selection
+            eligible = tree.is_leaf & jnp.isfinite(best.gain) & \
+                ((best.gain > 0) | forced_ok)
         if max_depth > 0:
             eligible &= tree.depth < max_depth
         gains = jnp.where(eligible[:m], best.gain[:m], -jnp.inf)
+        if use_forced:
+            gains = jnp.where(eligible[:m] & forced_ok[:m],
+                              1e30 + best.gain[:m], gains)
         budget = num_leaves - tree.num_leaves
         k_allowed = jnp.minimum(jnp.asarray(1 if leafwise else k_top),
                                 budget)
@@ -381,6 +497,26 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         new_best = best._replace(
             gain=scat(best.gain, jnp.full(m + 1, -jnp.inf, jnp.float32),
                       jnp.full(m + 1, -jnp.inf, jnp.float32)))
+        if use_forced:
+            # children of a forced node inherit the spec's subtree
+            nf = st.node_force
+            spx = jnp.clip(nf, 0, n_spec - 1)
+            # inherit only when the forced split itself was applied; a node
+            # that fell back to a gain-chosen split stops forcing (the
+            # reference stops its BFS when a forced split is inapplicable)
+            inherit = split_mask & (nf >= 0) & forced_ok
+            node_force = scat(nf,
+                              jnp.where(inherit, forced_left[spx], -1),
+                              jnp.where(inherit, forced_right[spx], -1))
+            zb_ = jnp.zeros(m + 1, bool)
+            forced_ok = scat(forced_ok, zb_, zb_)
+        else:
+            node_force = st.node_force
+        if use_cegb and cegb_cfg.has_coupled:
+            feat_used = st.feat_used.at[jnp.clip(feat, 0, f - 1)].max(
+                split_mask)
+        else:
+            feat_used = st.feat_used
 
         # monotone bound propagation (basic method: after a split on a
         # monotone feature, mid = (l_out + r_out)/2 caps the increasing
@@ -436,11 +572,22 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             jnp.where(is_nan_bin, best.default_left[pnode], binv <= thr))
         row_node = jnp.where(
             pm, jnp.where(go_left, child_l[pnode], child_r[pnode]), pnode)
+        if use_cegb and cegb_cfg.has_lazy:
+            # rows in a just-split node are now charged for its feature
+            # (CalculateOndemandCosts marking, the reference's
+            # is_feature_used_ per-datapoint flags)
+            row_feat_used = st.row_feat_used.at[jnp.arange(n), pf].max(pm)
+        else:
+            row_feat_used = st.row_feat_used
 
         done = (k == 0) | (new_tree.num_leaves >= num_leaves)
         return _GrowState(new_tree, row_node, slot_of_node, slot_nodes,
-                          new_best, cons_min, cons_max, path_mask,
+                          new_best, node_force, forced_ok, feat_used,
+                          row_feat_used, cons_min, cons_max, path_mask,
                           st.pass_idx + 1, done)
 
     final = jax.lax.while_loop(cond, body, state)
+    if use_cegb:
+        return final.tree, final.row_node, (final.feat_used,
+                                            final.row_feat_used)
     return final.tree, final.row_node

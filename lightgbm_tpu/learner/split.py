@@ -129,7 +129,8 @@ def find_best_splits(hist: jax.Array, parent_grad: jax.Array,
                      cons_min: jax.Array = None,
                      cons_max: jax.Array = None,
                      depth: jax.Array = None,
-                     rand_bins: jax.Array = None) -> BestSplits:
+                     rand_bins: jax.Array = None,
+                     gain_penalty: jax.Array = None) -> BestSplits:
     """Find the best split per slot.
 
     Args:
@@ -140,6 +141,11 @@ def find_best_splits(hist: jax.Array, parent_grad: jax.Array,
       is_cat: [F] bool.
       feature_mask: [F] or [S, F] float/bool — 0 disables a feature
         (feature_fraction / feature-parallel shard / voting selection).
+      gain_penalty: optional [S, F] gain subtracted per (slot, feature)
+        after threshold selection — the CEGB DeltaGain hook (reference
+        SerialTreeLearner::FindBestSplitsFromHistograms subtracting
+        CostEfficientGradientBoosting::DetlaGain,
+        cost_effective_gradient_boosting.hpp:46-70).
     """
     s, f, b, _ = hist.shape
     l1, l2 = hp.lambda_l1, hp.lambda_l2
@@ -301,6 +307,11 @@ def find_best_splits(hist: jax.Array, parent_grad: jax.Array,
     num_gain = jnp.where(num_gain > min_gain_shift[:, None, None],
                          num_gain, -jnp.inf)
     all_gain = jnp.where(is_cat[None, :, None], cat_gain, num_gain)  # [S,F,B]
+    if gain_penalty is not None:
+        # constant across thresholds of one feature, so the per-feature
+        # argmax is unchanged; only cross-feature competition and the
+        # stored/selection gain see the penalty (as in the reference)
+        all_gain = all_gain - gain_penalty[:, :, None]
 
     flat = all_gain.reshape(s, f * b)
     best_idx = jnp.argmax(flat, axis=1)                            # [S]

@@ -291,8 +291,10 @@ class HostModel:
     # ------------------------------------------------------------------
     def predict(self, X: np.ndarray, start_iteration: int = 0,
                 num_iteration: Optional[int] = None, raw_score: bool = False,
-                pred_leaf: bool = False,
-                pred_contrib: bool = False) -> np.ndarray:
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                pred_early_stop: bool = False,
+                pred_early_stop_freq: int = 10,
+                pred_early_stop_margin: float = 10.0) -> np.ndarray:
         k = max(self.num_tree_per_iteration, 1)
         total_iters = self.num_iterations
         if num_iteration is None or num_iteration <= 0:
@@ -308,9 +310,42 @@ class HostModel:
         if pred_contrib:
             return self.predict_contrib(X, start_iteration, end_iteration)
         out = np.zeros((n, k), np.float64)
-        for ti in rng:
+        # margin-based prediction early stop (reference
+        # prediction_early_stop.cpp: binary margin = 2|p|, multiclass
+        # margin = top1 - top2, checked every round_period trees; rows past
+        # the margin stop accumulating further trees)
+        obj = self.objective.split(" ")[0]
+        use_early = (pred_early_stop and not self.average_output and
+                     (k > 1 or obj in ("binary", "cross_entropy",
+                                       "xentropy")))
+        # checks happen on iteration boundaries only, so every class has an
+        # equal tree count when a row is retired; rows are re-sliced only
+        # when the active set changes (at a check), not per tree
+        check_every = max(pred_early_stop_freq, 1) * k
+        act_idx = None          # None = all rows active
+        Xa = X
+        for j, ti in enumerate(rng):
             cls = self.tree_class[ti] if ti < len(self.tree_class) else ti % k
-            out[:, cls] += self.trees[ti].predict_rows(X)
+            if act_idx is None:
+                out[:, cls] += self.trees[ti].predict_rows(X)
+            else:
+                out[act_idx, cls] += self.trees[ti].predict_rows(Xa)
+            if use_early and (j + 1) % check_every == 0:
+                if k == 1:
+                    margin = 2.0 * np.abs(out[:, 0])
+                else:
+                    part = np.partition(out, k - 2, axis=1)
+                    margin = part[:, k - 1] - part[:, k - 2]
+                active = margin < pred_early_stop_margin
+                if act_idx is not None:
+                    keep = np.zeros(n, bool)
+                    keep[act_idx] = True
+                    active &= keep
+                if not active.all() or act_idx is not None:
+                    act_idx = np.flatnonzero(active)
+                    if act_idx.size == 0:
+                        break
+                    Xa = X[act_idx]
         if self.average_output:
             out /= max(end_iteration - start_iteration, 1)
         if not raw_score:

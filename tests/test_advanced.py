@@ -1,0 +1,137 @@
+"""Forced splits, CEGB penalties, prediction early-stop
+(reference test_engine.py test_forced_split / test_cegb /
+test_pred_early_stopping sections)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from conftest import make_binary, make_multiclass
+
+
+class TestForcedSplits:
+    def _train(self, tmp_path, spec, n_leaves=8, rounds=3):
+        r = np.random.RandomState(0)
+        X = r.randn(2000, 5).astype(np.float32)
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+        fn = tmp_path / "forced.json"
+        fn.write_text(json.dumps(spec))
+        bst = lgb.train({"objective": "binary", "num_leaves": n_leaves,
+                         "forcedsplits_filename": str(fn), "verbosity": -1,
+                         "min_data_in_leaf": 5},
+                        lgb.Dataset(X, label=y), rounds)
+        return bst, X, y
+
+    def test_root_split_forced(self, tmp_path):
+        bst, _, _ = self._train(tmp_path,
+                                {"feature": 2, "threshold": 0.0})
+        for t in bst.dump_model()["tree_info"]:
+            assert t["tree_structure"]["split_feature"] == 2
+
+    def test_nested_forced_splits(self, tmp_path):
+        spec = {"feature": 2, "threshold": 0.0,
+                "left": {"feature": 3, "threshold": 0.5},
+                "right": {"feature": 4, "threshold": -0.5}}
+        bst, _, _ = self._train(tmp_path, spec)
+        root = bst.dump_model()["tree_info"][0]["tree_structure"]
+        assert root["split_feature"] == 2
+        assert root["left_child"]["split_feature"] == 3
+        assert root["right_child"]["split_feature"] == 4
+        assert root["right_child"]["threshold"] == pytest.approx(-0.5,
+                                                                 abs=0.2)
+
+    def test_accuracy_not_destroyed(self, tmp_path):
+        bst, X, y = self._train(tmp_path,
+                                {"feature": 4, "threshold": 0.0},
+                                n_leaves=16, rounds=20)
+        acc = np.mean((bst.predict(X) > 0.5) == y)
+        assert acc > 0.9
+
+    def test_unused_feature_ignored(self, tmp_path):
+        # feature 99 doesn't exist -> spec dropped, training proceeds
+        bst, X, y = self._train(tmp_path, {"feature": 99, "threshold": 0.0})
+        assert bst.num_trees() > 0
+
+
+class TestCEGB:
+    def _data(self):
+        r = np.random.RandomState(1)
+        X = r.randn(3000, 6).astype(np.float32)
+        y = (X[:, 0] + 0.5 * X[:, 1] +
+             0.1 * r.randn(3000) > 0).astype(np.float32)
+        return X, y
+
+    def test_coupled_penalty_blocks_feature(self):
+        X, y = self._data()
+        pen = [0.0, 1e6, 0.0, 0.0, 0.0, 0.0]
+        bst = lgb.train({"objective": "binary", "num_leaves": 16,
+                         "verbosity": -1, "cegb_tradeoff": 1.0,
+                         "cegb_penalty_feature_coupled": pen},
+                        lgb.Dataset(X, label=y), 5)
+        assert bst.feature_importance()[1] == 0
+
+    def test_split_penalty_shrinks_trees(self):
+        X, y = self._data()
+        base = {"objective": "binary", "num_leaves": 32, "verbosity": -1}
+        b0 = lgb.train(base, lgb.Dataset(X, label=y), 5)
+        b1 = lgb.train({**base, "cegb_penalty_split": 0.1},
+                       lgb.Dataset(X, label=y), 5)
+        n0 = sum(t["num_leaves"] for t in b0.dump_model()["tree_info"])
+        n1 = sum(t["num_leaves"] for t in b1.dump_model()["tree_info"])
+        assert n1 < n0
+
+    def test_lazy_penalty_trains(self):
+        X, y = self._data()
+        bst = lgb.train({"objective": "binary", "num_leaves": 16,
+                         "verbosity": -1,
+                         "cegb_penalty_feature_lazy": [0.01] * 6},
+                        lgb.Dataset(X, label=y), 5)
+        acc = np.mean((bst.predict(X) > 0.5) == y)
+        assert acc > 0.9
+
+    def test_lazy_penalty_concentrates_features(self):
+        # a uniform lazy penalty favors re-using already-charged features,
+        # so the used-feature set should not grow vs the unpenalized model
+        X, y = self._data()
+        base = {"objective": "binary", "num_leaves": 16, "verbosity": -1}
+        b0 = lgb.train(base, lgb.Dataset(X, label=y), 5)
+        b1 = lgb.train({**base, "cegb_penalty_feature_lazy": [10.0] * 6},
+                       lgb.Dataset(X, label=y), 5)
+        used0 = np.sum(b0.feature_importance() > 0)
+        used1 = np.sum(b1.feature_importance() > 0)
+        assert used1 <= used0
+
+
+class TestPredEarlyStop:
+    def test_binary_matches_when_margin_huge(self):
+        X, y = make_binary()
+        bst = lgb.train({"objective": "binary", "verbosity": -1},
+                        lgb.Dataset(X, label=y), 30)
+        full = bst.predict(X)
+        es = bst.predict(X, pred_early_stop=True,
+                         pred_early_stop_freq=5,
+                         pred_early_stop_margin=1e10)
+        np.testing.assert_allclose(full, es, rtol=1e-6)
+
+    def test_binary_approximates_with_margin(self):
+        X, y = make_binary()
+        bst = lgb.train({"objective": "binary", "verbosity": -1},
+                        lgb.Dataset(X, label=y), 60)
+        full = bst.predict(X)
+        es = bst.predict(X, pred_early_stop=True,
+                         pred_early_stop_freq=5,
+                         pred_early_stop_margin=1.5)
+        # hard-classification agreement stays high even though margins differ
+        agree = np.mean((full > 0.5) == (es > 0.5))
+        assert agree > 0.95
+
+    def test_multiclass_early_stop(self):
+        X, y = make_multiclass(k=3)
+        bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                         "verbosity": -1}, lgb.Dataset(X, label=y), 30)
+        full = bst.predict(X).argmax(axis=1)
+        es = bst.predict(X, pred_early_stop=True, pred_early_stop_freq=3,
+                         pred_early_stop_margin=3.0).argmax(axis=1)
+        assert np.mean(full == es) > 0.95
