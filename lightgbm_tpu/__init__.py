@@ -13,9 +13,14 @@ from .utils.log import LightGBMError, register_logger
 
 try:  # user-facing API (available once all layers are built)
     from .basic import Booster, Dataset
+    from .callback import (early_stopping, log_evaluation,
+                           record_evaluation, reset_parameter)
     from .engine import cv, train
+    from .plotting import plot_importance, plot_metric, plot_tree
 except ImportError:  # pragma: no cover - during partial builds only
     pass
 
 __all__ = ["Dataset", "Booster", "train", "cv", "Config", "LightGBMError",
-           "register_logger", "__version__"]
+           "register_logger", "early_stopping", "log_evaluation",
+           "record_evaluation", "reset_parameter", "plot_importance",
+           "plot_metric", "plot_tree", "__version__"]

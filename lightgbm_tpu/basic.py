@@ -159,12 +159,14 @@ class Dataset:
                 X, md, max_bin=cfg.max_bin,
                 min_data_in_bin=cfg.min_data_in_bin,
                 mappers=ref_mappers, feature_names=names,
-                feature_pre_filter=False)
+                feature_pre_filter=False, keep_raw=cfg.linear_tree)
             # keep only the reference's used features
             keep = ref.used_features
             self._binned = BinnedDataset(
                 self._binned.bins[:, keep], [ref_mappers[int(f)] for f in keep],
-                keep, ref.num_total_features, md, names)
+                keep, ref.num_total_features, md, names,
+                raw=None if self._binned.raw is None
+                else self._binned.raw[:, keep])
         else:
             self._binned = BinnedDataset.from_raw(
                 X, md, max_bin=cfg.max_bin,
@@ -174,7 +176,8 @@ class Dataset:
                 zero_as_missing=cfg.zero_as_missing,
                 categorical_features=cat, seed=cfg.data_random_seed,
                 feature_names=names,
-                feature_pre_filter=cfg.feature_pre_filter)
+                feature_pre_filter=cfg.feature_pre_filter,
+                keep_raw=cfg.linear_tree)
         if self.free_raw_data:
             self.data = None
         return self
@@ -318,6 +321,9 @@ class Booster:
     # ------------------------------------------------------------------
     def add_valid(self, data: Dataset, name: str) -> "Booster":
         data.reference = self.train_set
+        if self.config.linear_tree and data._binned is None:
+            # valid sets need raw values too when leaves hold linear models
+            data.params = dict(data.params or {}, linear_tree=True)
         data.construct()
         cfg = self.config
         metrics = [m for m in (create_metric(nm, cfg)

@@ -67,18 +67,24 @@ class DART(GBDT):
         scaled = tree._replace(leaf_value=tree.leaf_value * factor)
         return scaled
 
+    def _lin(self, idx: int):
+        return self.linear_models[idx] \
+            if idx < len(self.linear_models) else None
+
     def _apply_tree_to_scores(self, it: int, cls: int, factor: float) -> None:
         k = self.num_tree_per_iteration
-        tree = self.trees[it * k + cls]
-        vals = self._predict_train_rows(tree) * factor
+        idx = it * k + cls
+        tree = self.trees[idx]
+        lin = self._lin(idx)
+        vals = self._tree_values(tree, lin, self.bins, self.raw) \
+            [:self.num_data] * factor
         if k == 1:
             self.train_score = self.train_score + vals
         else:
             self.train_score = self.train_score.at[:, cls].add(vals)
         for i in range(len(self.valid_sets)):
-            vv = predict_binned_tree(tree, self.valid_bins[i],
-                                     self.num_bins_d,
-                                     self.missing_is_nan_d) * factor
+            vv = self._tree_values(tree, lin, self.valid_bins[i],
+                                   self.valid_raws[i]) * factor
             if k == 1:
                 self.valid_scores[i] = self.valid_scores[i] + vv
             else:
@@ -110,9 +116,11 @@ class DART(GBDT):
         for cls in range(k):
             idx = len(self.trees) - k + cls
             tree = self.trees[idx]
+            lin = self._lin(idx)
             if new_factor != 1.0:
                 # remove over-counted part from scores
-                vals = self._predict_train_rows(tree) * (new_factor - 1.0)
+                vals = self._tree_values(tree, lin, self.bins, self.raw) \
+                    [:self.num_data] * (new_factor - 1.0)
                 cls_id = self.tree_class[idx]
                 if k == 1:
                     self.train_score = self.train_score + vals
@@ -120,9 +128,9 @@ class DART(GBDT):
                     self.train_score = \
                         self.train_score.at[:, cls_id].add(vals)
                 for i in range(len(self.valid_sets)):
-                    vv = predict_binned_tree(
-                        tree, self.valid_bins[i], self.num_bins_d,
-                        self.missing_is_nan_d) * (new_factor - 1.0)
+                    vv = self._tree_values(
+                        tree, lin, self.valid_bins[i],
+                        self.valid_raws[i]) * (new_factor - 1.0)
                     if k == 1:
                         self.valid_scores[i] = self.valid_scores[i] + vv
                     else:
@@ -130,6 +138,10 @@ class DART(GBDT):
                             self.valid_scores[i].at[:, cls_id].add(vv)
                 self.trees[idx] = tree._replace(
                     leaf_value=tree.leaf_value * new_factor)
+                if lin is not None:
+                    self.linear_models[idx] = lin._replace(
+                        const=lin.const * new_factor,
+                        coeff=lin.coeff * new_factor)
         self.tree_weights.append(new_factor)
         # scale dropped trees back in with old_factor
         for it in self.drop_indices:
@@ -138,6 +150,11 @@ class DART(GBDT):
                 idx = it * k + cls
                 self.trees[idx] = self.trees[idx]._replace(
                     leaf_value=self.trees[idx].leaf_value * old_factor)
+                lm = self._lin(idx)
+                if lm is not None:
+                    self.linear_models[idx] = lm._replace(
+                        const=lm.const * old_factor,
+                        coeff=lm.coeff * old_factor)
             self.tree_weights[it] *= old_factor
         if self.drop_indices:
             Log.debug("DART: dropped %d trees", len(self.drop_indices))

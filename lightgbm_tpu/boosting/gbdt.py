@@ -54,6 +54,7 @@ class GBDT:
         self.iter_ = 0
         self.trees: List[TreeArrays] = []       # flat: iter*K + class
         self.tree_class: List[int] = []
+        self.linear_models: List = []           # LinearLeaves or None, per tree
         self.models_meta: List[dict] = []       # host-side per-tree info
         self.valid_sets: List[BinnedDataset] = []
         self.valid_names: List[str] = []
@@ -145,6 +146,22 @@ class GBDT:
             backend not in ("cpu",)) else "scatter"
         if self._hist_impl == "pallas":
             Log.debug("Using Pallas histogram kernel (backend=%s)", backend)
+        # linear trees (reference LinearTreeLearner; raw values required,
+        # dataset.cpp:418-420)
+        self._linear = bool(cfg.linear_tree)
+        self.raw = None
+        self.valid_raws: List = []
+        if self._linear:
+            # config validation already forces tree_learner=serial for
+            # linear trees, so self._grower is always None here
+            if ds.raw is None:
+                raise ValueError(
+                    "linear_tree=true requires raw feature values; "
+                    "reconstruct the dataset with linear_tree in params")
+            else:
+                self.raw = jnp.asarray(ds.raw)
+                depth_cap = cfg.max_depth if cfg.max_depth > 0 else 31
+                self._lin_dmax = max(1, min(ds.num_features, depth_cap, 31))
         self._bag_mask = jnp.ones(self.num_data, jnp.float32)
         self._boosted_from_average = [False] * k
         if self.objective is not None:
@@ -166,10 +183,14 @@ class GBDT:
         used = np.asarray(ds.used_features, np.int64)
 
         def _per_used(pen):
-            arr = np.zeros(ds.num_total_features, np.float32)
             pen = np.asarray(pen, np.float32)
-            arr[:len(pen)] = pen
-            return jnp.asarray(arr[used])
+            if len(pen) != ds.num_total_features:
+                # the reference requires one penalty per feature
+                # (config check on cegb_penalty_feature_* size)
+                raise ValueError(
+                    f"cegb per-feature penalty has {len(pen)} entries but "
+                    f"the dataset has {ds.num_total_features} features")
+            return jnp.asarray(pen[used])
 
         self._cegb_cfg = CegbParams(
             tradeoff=float(cfg.cegb_tradeoff),
@@ -334,10 +355,20 @@ class GBDT:
             self.valid_bins: List[jax.Array] = []
         self.valid_scores.append(score)
         self.valid_bins.append(jnp.asarray(ds.bins))
+        if self._linear:
+            if ds.raw is None:
+                raise ValueError(
+                    "linear_tree model needs raw values on validation "
+                    "sets; construct them with linear_tree in params")
+            self.valid_raws.append(jnp.asarray(ds.raw))
+        else:
+            self.valid_raws.append(None)
         # replay existing model on the new valid set
-        for t, cls in zip(self.trees, self.tree_class):
-            vals = predict_binned_tree(t, self.valid_bins[-1],
-                                       self.num_bins_d, self.missing_is_nan_d)
+        for ti, (t, cls) in enumerate(zip(self.trees, self.tree_class)):
+            lin = self.linear_models[ti] \
+                if ti < len(self.linear_models) else None
+            vals = self._tree_values(t, lin, self.valid_bins[-1],
+                                     self.valid_raws[-1])
             vi = len(self.valid_scores) - 1
             if k == 1:
                 self.valid_scores[vi] = self.valid_scores[vi] + vals
@@ -428,6 +459,7 @@ class GBDT:
                 feature_mask = self._feature_mask()
                 tree, row_node = self._grow(g, h, cnt, feature_mask)
             nleaves = int(tree.num_leaves)
+            lin = None
             if nleaves > 1:
                 should_continue = True
                 if self.objective is not None and \
@@ -439,11 +471,24 @@ class GBDT:
                         else self.train_score[:, cls],
                         jnp.asarray(self.objective.label), rw,
                         self.objective.renew_percentile, cfg.num_leaves)
-                # shrinkage (tree.cpp Shrinkage): scale leaf outputs
+                if self._linear:
+                    from ..learner.linear import fit_linear_leaves
+                    with global_timer.timeit("linear_fit"):
+                        lin = fit_linear_leaves(
+                            tree, row_node, self.raw, g, h, cnt,
+                            self.is_cat_d,
+                            jnp.float32(cfg.linear_lambda),
+                            dmax=self._lin_dmax)
+                # shrinkage (tree.cpp Shrinkage): scale leaf outputs and,
+                # for linear leaves, consts + coefficients
                 tree = tree._replace(
                     leaf_value=tree.leaf_value * self.shrinkage_rate)
+                if lin is not None:
+                    lin = lin._replace(
+                        const=lin.const * self.shrinkage_rate,
+                        coeff=lin.coeff * self.shrinkage_rate)
                 with global_timer.timeit("update_score"):
-                    self._update_score(tree, row_node, cls)
+                    self._update_score(tree, row_node, cls, lin)
                 if abs(init_scores[cls]) > 1e-35:
                     # AddBias (gbdt.cpp:416-417): fold init into tree 0
                     tree = tree._replace(
@@ -451,6 +496,10 @@ class GBDT:
                             tree.split_feature < 0,
                             tree.leaf_value + init_scores[cls],
                             tree.leaf_value))
+                    if lin is not None:
+                        lin = lin._replace(const=jnp.where(
+                            tree.split_feature < 0,
+                            lin.const + init_scores[cls], lin.const))
             else:
                 if len(self.trees) < k:
                     if self.objective is not None and \
@@ -461,6 +510,7 @@ class GBDT:
                     tree = self._constant_tree(init_scores[cls])
             self.trees.append(tree)
             self.tree_class.append(cls)
+            self.linear_models.append(lin)
         self.iter_ += 1
         return not should_continue
 
@@ -519,20 +569,36 @@ class GBDT:
                 self.valid_scores[i] = \
                     self.valid_scores[i].at[:, cls].add(value)
 
+    def _tree_values(self, tree: TreeArrays, lin, bins: jax.Array,
+                     raw) -> jax.Array:
+        """Per-row outputs of one tree on a binned matrix (linear-aware)."""
+        if lin is None:
+            return predict_binned_tree(tree, bins, self.num_bins_d,
+                                       self.missing_is_nan_d)
+        from ..learner.linear import linear_leaf_values
+        from ..learner.predict import leaf_node_tree
+        leaf = leaf_node_tree(tree, bins, self.num_bins_d,
+                              self.missing_is_nan_d)
+        return linear_leaf_values(tree, lin, leaf, raw)
+
     def _update_score(self, tree: TreeArrays, row_node: jax.Array,
-                      cls: int) -> None:
+                      cls: int, lin=None) -> None:
         """Learner-side score update: leaf value via row->node gather
         (score_updater.hpp:21-110 AddScore(tree_learner) equivalent)."""
-        vals = tree.leaf_value[row_node]
+        if lin is None:
+            vals = tree.leaf_value[row_node]
+        else:
+            from ..learner.linear import linear_leaf_values
+            vals = linear_leaf_values(tree, lin, row_node, self.raw)
         k = self.num_tree_per_iteration
         if k == 1:
             self.train_score = self.train_score + vals
         else:
             self.train_score = self.train_score.at[:, cls].add(vals)
         for i in range(len(self.valid_sets)):
-            vvals = predict_binned_tree(tree, self.valid_bins[i],
-                                        self.num_bins_d,
-                                        self.missing_is_nan_d)
+            vvals = self._tree_values(tree, lin, self.valid_bins[i],
+                                      self.valid_raws[i]
+                                      if self.valid_raws else None)
             if k == 1:
                 self.valid_scores[i] = self.valid_scores[i] + vvals
             else:
@@ -548,15 +614,19 @@ class GBDT:
         for cls in range(k):
             tree = self.trees.pop()
             cls_id = self.tree_class.pop()
-            vals = self._predict_train_rows(tree)
+            lin = self.linear_models.pop() if self.linear_models else None
+            if lin is None:
+                vals = self._predict_train_rows(tree)
+            else:
+                vals = self._tree_values(tree, lin, self.bins, self.raw) \
+                    [:self.num_data]
             if k == 1:
                 self.train_score = self.train_score - vals
             else:
                 self.train_score = self.train_score.at[:, cls_id].add(-vals)
             for i in range(len(self.valid_sets)):
-                vv = predict_binned_tree(tree, self.valid_bins[i],
-                                         self.num_bins_d,
-                                         self.missing_is_nan_d)
+                vv = self._tree_values(tree, lin, self.valid_bins[i],
+                                       self.valid_raws[i])
                 if k == 1:
                     self.valid_scores[i] = self.valid_scores[i] - vv
                 else:

@@ -51,10 +51,10 @@ class RF(GBDT):
         del prev_iter
         return stop
 
-    def _update_score(self, tree, row_node, cls):
+    def _update_score(self, tree, row_node, cls, lin=None):
         # RF averages trees: score = init + sum(tree)/iter. We keep raw sum
         # during training and divide at evaluation time.
-        super()._update_score(tree, row_node, cls)
+        super()._update_score(tree, row_node, cls, lin)
 
     def _eval(self, score, metrics, ds):
         # average the accumulated sum over trees and add init score

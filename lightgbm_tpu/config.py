@@ -336,6 +336,21 @@ class Config:
             self.monotone_constraints_method = "basic"
         if self.linear_tree and self.boosting == "goss":
             raise ValueError("linear_tree is not supported with goss boosting")
+        if self.linear_tree:
+            # reference conflicts (config.cpp:357-371): serial learner only,
+            # no zero_as_missing, no L1 regression
+            if self.tree_learner != "serial":
+                from .utils.log import Log
+                Log.warning("Linear tree learner must be serial; "
+                            "tree_learner=%s ignored", self.tree_learner)
+                self.tree_learner = "serial"
+            if self.zero_as_missing:
+                raise ValueError("zero_as_missing must be false when "
+                                 "fitting linear trees")
+            if self.objective in ("regression_l1", "l1", "mae",
+                                  "mean_absolute_error"):
+                raise ValueError("Cannot use regression_l1 objective when "
+                                 "fitting linear trees")
 
     @property
     def is_parallel(self) -> bool:

@@ -96,9 +96,13 @@ class BinnedDataset:
     def __init__(self, bins: np.ndarray, mappers: List[BinMapper],
                  used_features: np.ndarray, num_total_features: int,
                  metadata: Metadata,
-                 feature_names: Optional[List[str]] = None):
+                 feature_names: Optional[List[str]] = None,
+                 raw: Optional[np.ndarray] = None):
         assert bins.shape[1] == len(used_features)
         self.bins = bins                      # [N, F_used] uint8/uint16
+        # raw (un-binned) values of the used features, kept only for
+        # linear trees (reference Dataset has_raw_, dataset.cpp:418-420)
+        self.raw = raw                        # [N, F_used] f32 or None
         self.mappers = mappers                # per USED feature
         self.used_features = used_features    # used idx -> original idx
         self.num_total_features = num_total_features
@@ -125,7 +129,8 @@ class BinnedDataset:
                  categorical_features: Optional[Sequence[int]] = None,
                  seed: int = 1, feature_names: Optional[List[str]] = None,
                  mappers: Optional[List[BinMapper]] = None,
-                 feature_pre_filter: bool = True) -> "BinnedDataset":
+                 feature_pre_filter: bool = True,
+                 keep_raw: bool = False) -> "BinnedDataset":
         """Quantize raw features. If `mappers` given, reuse them (aligned
         valid set — reference LoadFromFileAlignWithOtherDataset,
         dataset_loader.cpp:299)."""
@@ -159,8 +164,10 @@ class BinnedDataset:
         for j, f in enumerate(used):
             binned[:, j] = used_mappers[j].values_to_bins(
                 np.asarray(X[:, f], dtype=np.float64)).astype(dtype)
+        raw = np.ascontiguousarray(
+            X[:, used], dtype=np.float32) if keep_raw else None
         return BinnedDataset(binned, used_mappers, used, num_total, metadata,
-                             feature_names)
+                             feature_names, raw=raw)
 
     # ---- accessors ----------------------------------------------------
     @property
@@ -182,7 +189,9 @@ class BinnedDataset:
             None if md.init_score is None else md.init_score[row_indices])
         return BinnedDataset(self.bins[row_indices], self.mappers,
                              self.used_features, self.num_total_features,
-                             sub_md, self.feature_names)
+                             sub_md, self.feature_names,
+                             raw=None if self.raw is None
+                             else self.raw[row_indices])
 
     # ---- binary cache -------------------------------------------------
     # Reference: Dataset::SaveBinaryFile / DatasetLoader::LoadFromBinFile
@@ -205,6 +214,8 @@ class BinnedDataset:
             mappers_json=np.frombuffer(
                 mapper_json.encode(), dtype=np.uint8),
         )
+        if self.raw is not None:
+            payload["raw"] = self.raw
         for fld in ("label", "weight", "init_score"):
             v = getattr(md, fld)
             if v is not None:
@@ -250,4 +261,5 @@ class BinnedDataset:
             return BinnedDataset(
                 bins, mappers, z["used_features"],
                 int(z["num_total_features"]), md,
-                [str(s) for s in z["feature_names"]])
+                [str(s) for s in z["feature_names"]],
+                raw=z["raw"] if "raw" in z else None)

@@ -1,0 +1,184 @@
+"""Linear models in tree leaves (linear_tree=true).
+
+TPU-native redesign of the reference LinearTreeLearner
+(src/treelearner/linear_tree_learner.cpp:150-380, "CalculateLinear"):
+after the tree structure is grown, every leaf gets a ridge-regularized
+linear model over the numerical features on its root path, fit against the
+same (grad, hess) Newton objective as the constant leaf values:
+
+    minimize  sum_i [ g_i f(x_i) + 0.5 h_i f(x_i)^2 ]  + 0.5 lambda |beta|^2
+    f(x) = beta . x_path + c     =>    [beta; c] = -(X'HX + lambda I)^-1 X'g
+
+The reference accumulates per-leaf upper-triangular X'HX with OMP threads
+and solves with Eigen fullPivLu per leaf. Here the whole accumulation is a
+`lax.scan` over row chunks of batched outer products (MXU work), and all
+leaves are solved at once with one batched `jnp.linalg.solve`.
+
+Parity details kept from the reference:
+- rows with NaN in any of their leaf's features are excluded from the fit
+  (linear_tree_learner.cpp:260-278) and fall back to the constant
+  `leaf_value` at prediction time (src/io/tree.cpp:133-150);
+- leaves with fewer usable rows than features+1 keep the constant output
+  (linear_tree_learner.cpp:325-333);
+- `linear_lambda` is added to the coefficient diagonal only, not the
+  intercept (linear_tree_learner.cpp:341-345);
+- categorical features never enter leaf models
+  (linear_tree_learner.cpp:209-216).
+
+Deviation: the number of distinct path features per leaf model is capped at
+a static `dmax` (feature count, max_depth and 31, whichever is smallest) to
+keep shapes fixed under jit; paths deeper than that drop the
+highest-indexed extra features.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .grower import TreeArrays
+
+__all__ = ["LinearLeaves", "fit_linear_leaves", "linear_leaf_values"]
+
+
+class LinearLeaves(NamedTuple):
+    """Per-node linear leaf models, arrays sized like TreeArrays [M+1]."""
+    const: jax.Array   # [M+1] f32 intercept (leaves; fallback = leaf_value)
+    coeff: jax.Array   # [M+1, D] f32 coefficients (0 where unused)
+    feat: jax.Array    # [M+1, D] i32 used-feature idx, -1 = pad
+    nfeat: jax.Array   # [M+1] i32 number of model features
+
+
+def _path_feature_masks(tree: TreeArrays, f: int, m1: int,
+                        is_cat: jax.Array) -> jax.Array:
+    """[M+1, F] bool: numerical features split on the root path of each
+    node (the reference's tree->branch_features,
+    linear_tree_learner.cpp:200-216)."""
+    nodes = jnp.arange(m1)
+
+    def cond(c):
+        cur, _ = c
+        return jnp.any(cur >= 0)
+
+    def body(c):
+        cur, mask = c
+        valid = cur >= 0
+        cc = jnp.clip(cur, 0, m1 - 1)
+        feat = tree.split_feature[cc]
+        fc = jnp.clip(feat, 0, f - 1)
+        upd = valid & (feat >= 0) & ~is_cat[fc]
+        mask = mask.at[nodes, fc].max(upd)
+        # scratch row m parents itself (grower scatter side effect) —
+        # a non-decreasing pointer means "stop", guarding the loop
+        nxt = tree.parent[cc]
+        return jnp.where(valid & (nxt != cur), nxt, -1), mask
+
+    start = tree.parent[nodes]
+    start = jnp.where(start == nodes, -1, start)
+    _, mask = jax.lax.while_loop(
+        cond, body, (start, jnp.zeros((m1, f), bool)))
+    return mask
+
+
+@functools.partial(jax.jit, static_argnames=("dmax", "chunk"))
+def fit_linear_leaves(tree: TreeArrays, row_node: jax.Array,
+                      raw: jax.Array, grad: jax.Array, hess: jax.Array,
+                      cnt_weight: jax.Array, is_cat_feat: jax.Array,
+                      linear_lambda: jax.Array, *, dmax: int,
+                      chunk: int = 8192) -> LinearLeaves:
+    """Fit all leaf models of one tree.
+
+    Args:
+      raw: [N, F] float32 raw (un-binned) feature values, NaN allowed.
+      row_node: [N] leaf node id per row (grower output).
+      grad/hess: per-row gradients/hessians with bagging folded in.
+      cnt_weight: 1.0 for in-bag rows (out-of-bag rows are excluded from
+        the fit, like the reference's leaf_map_[i] < 0 skip).
+    """
+    n, f = raw.shape
+    m1 = tree.split_feature.shape[0]
+    d1 = dmax + 1
+
+    mask = _path_feature_masks(tree, f, m1, is_cat_feat)
+    # first `dmax` set features in ascending index order (top_k tie-break)
+    v, idx = jax.lax.top_k(mask.astype(jnp.float32), min(dmax, f))
+    feat = jnp.where(v > 0, idx, -1).astype(jnp.int32)            # [M+1, <=D]
+    if feat.shape[1] < dmax:
+        feat = jnp.pad(feat, ((0, 0), (0, dmax - feat.shape[1])),
+                       constant_values=-1)
+    nfeat = jnp.sum(feat >= 0, axis=1).astype(jnp.int32)          # [M+1]
+
+    # ---- chunked accumulation of X'HX, X'g, usable-row counts ----
+    pad = (-n) % chunk
+    nc = (n + pad) // chunk
+    rawp = jnp.pad(raw, ((0, pad), (0, 0)))
+    leafp = jnp.pad(row_node, (0, pad), constant_values=m1 - 1)
+    gp = jnp.pad(grad, (0, pad))
+    hp = jnp.pad(hess, (0, pad))
+    cp = jnp.pad(cnt_weight, (0, pad))
+
+    def step(carry, inp):
+        xthx, xtg, nz = carry
+        rawc, leafc, gc, hc, cc = inp
+        lf = feat[leafc]                                          # [C, D]
+        fm = lf >= 0
+        xg = jnp.take_along_axis(rawc, jnp.clip(lf, 0, f - 1), axis=1)
+        nanr = jnp.any(jnp.isnan(xg) & fm, axis=1)
+        x = jnp.where(fm & ~jnp.isnan(xg), xg, 0.0)
+        xt = jnp.concatenate([x, jnp.ones((x.shape[0], 1), x.dtype)], 1)
+        vrow = (~nanr) & (cc > 0)
+        wh = jnp.where(vrow, hc, 0.0)
+        wg = jnp.where(vrow, gc, 0.0)
+        outer = xt[:, :, None] * xt[:, None, :] * wh[:, None, None]
+        xthx = xthx.at[leafc].add(outer)
+        xtg = xtg.at[leafc].add(xt * wg[:, None])
+        nz = nz.at[leafc].add(vrow.astype(jnp.int32))
+        return (xthx, xtg, nz), None
+
+    init = (jnp.zeros((m1, d1, d1), jnp.float32),
+            jnp.zeros((m1, d1), jnp.float32),
+            jnp.zeros(m1, jnp.int32))
+    (xthx, xtg, nz), _ = jax.lax.scan(
+        step, init,
+        (rawp.reshape(nc, chunk, f), leafp.reshape(nc, chunk),
+         gp.reshape(nc, chunk), hp.reshape(nc, chunk),
+         cp.reshape(nc, chunk)))
+
+    # ---- batched ridge solve ----
+    lam_diag = jnp.concatenate(
+        [jnp.full(dmax, 1.0, jnp.float32), jnp.zeros(1, jnp.float32)])
+    a = xthx + (linear_lambda * jnp.diag(lam_diag))[None]
+    # inactive feature slots: identity row/col + zero rhs => coeff 0
+    active = jnp.concatenate([feat >= 0, jnp.ones((m1, 1), bool)], axis=1)
+    pair = active[:, :, None] & active[:, None, :]
+    a = jnp.where(pair, a, jnp.eye(d1, dtype=jnp.float32)[None])
+    rhs = jnp.where(active, xtg, 0.0)
+    sol = -jnp.linalg.solve(a, rhs[..., None])[..., 0]            # [M+1, D+1]
+
+    ok = (tree.is_leaf & (nfeat > 0) & (nz >= nfeat + 1) &
+          jnp.all(jnp.isfinite(sol), axis=1))
+    const = jnp.where(ok, sol[:, dmax], tree.leaf_value)
+    coeff = jnp.where(ok[:, None], sol[:, :dmax], 0.0)
+    coeff = jnp.where(feat >= 0, coeff, 0.0)
+    nfeat = jnp.where(ok, nfeat, 0)
+    return LinearLeaves(const=const, coeff=coeff,
+                        feat=jnp.where(nfeat[:, None] > 0, feat, -1),
+                        nfeat=nfeat)
+
+
+@jax.jit
+def linear_leaf_values(tree: TreeArrays, lin: LinearLeaves,
+                       leaf: jax.Array, raw: jax.Array) -> jax.Array:
+    """[N] leaf-model outputs for rows routed to `leaf`; NaN in any model
+    feature falls back to the constant leaf_value (tree.cpp:133-150)."""
+    f = raw.shape[1]
+    lf = lin.feat[leaf]                                           # [N, D]
+    fm = lf >= 0
+    xg = jnp.take_along_axis(raw, jnp.clip(lf, 0, f - 1), axis=1)
+    nanr = jnp.any(jnp.isnan(xg) & fm, axis=1)
+    x = jnp.where(fm & ~jnp.isnan(xg), xg, 0.0)
+    val = lin.const[leaf] + jnp.sum(lin.coeff[leaf] * x, axis=1)
+    return jnp.where(nanr, tree.leaf_value[leaf], val)

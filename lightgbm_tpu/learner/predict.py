@@ -18,7 +18,8 @@ import jax.numpy as jnp
 
 from .grower import TreeArrays
 
-__all__ = ["predict_binned_tree", "predict_binned_forest", "leaf_index_tree"]
+__all__ = ["predict_binned_tree", "predict_binned_forest",
+           "leaf_index_tree", "leaf_node_tree"]
 
 
 def _traverse(tree: TreeArrays, bins: jax.Array, num_bins: jax.Array,
@@ -58,6 +59,13 @@ def predict_binned_tree(tree: TreeArrays, bins: jax.Array,
     """[N] leaf values of one tree."""
     leaf = _traverse(tree, bins, num_bins, missing_is_nan)
     return tree.leaf_value[leaf]
+
+
+@jax.jit
+def leaf_node_tree(tree: TreeArrays, bins: jax.Array, num_bins: jax.Array,
+                   missing_is_nan: jax.Array) -> jax.Array:
+    """[N] leaf NODE id per row (for linear-leaf model lookup)."""
+    return _traverse(tree, bins, num_bins, missing_is_nan)
 
 
 @jax.jit

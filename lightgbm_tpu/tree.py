@@ -69,6 +69,11 @@ class HostTree:
         default_factory=lambda: np.zeros(0, np.uint32))
     shrinkage: float = 1.0
     is_linear: bool = False
+    # linear leaves (reference tree.h leaf_const_/leaf_coeff_/leaf_features_)
+    leaf_const: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros(0, np.float64))   # [nl]
+    leaf_coeff: List[np.ndarray] = dataclasses.field(default_factory=list)
+    leaf_features: List[np.ndarray] = dataclasses.field(default_factory=list)
 
     @property
     def num_cat(self) -> int:
@@ -77,7 +82,31 @@ class HostTree:
     # ---- prediction (reference tree.h:335-412 decisions) -------------
     def predict_rows(self, X: np.ndarray) -> np.ndarray:
         leaf = self.leaf_index_rows(X)
-        return self.leaf_value[leaf]
+        if not self.is_linear:
+            return self.leaf_value[leaf]
+        # linear leaves: const + coeff . x, NaN in any model feature falls
+        # back to the constant leaf_value (tree.cpp:133-150).
+        # Rows grouped by leaf with one argsort, not a scan per leaf.
+        out = np.empty(len(leaf), np.float64)
+        order = np.argsort(leaf, kind="stable")
+        bounds = np.searchsorted(leaf[order], np.arange(self.num_leaves + 1))
+        for li in range(self.num_leaves):
+            rows = order[bounds[li]:bounds[li + 1]]
+            if rows.size == 0:
+                continue
+            feats = self.leaf_features[li] if li < len(self.leaf_features) \
+                else np.zeros(0, np.int32)
+            const = float(self.leaf_const[li]) if li < len(self.leaf_const) \
+                else float(self.leaf_value[li])
+            if len(feats) == 0:
+                out[rows] = const
+                continue
+            xv = X[np.ix_(rows, feats)]
+            v = const + xv @ np.asarray(self.leaf_coeff[li], np.float64)
+            nanr = np.isnan(xv).any(axis=1)
+            v[nanr] = self.leaf_value[li]
+            out[rows] = v
+        return out
 
     def leaf_index_rows(self, X: np.ndarray) -> np.ndarray:
         n = X.shape[0]
@@ -149,8 +178,22 @@ class HostTree:
         if self.num_cat > 0:
             lines += ["cat_boundaries=" + _join(self.cat_boundaries),
                       "cat_threshold=" + _join(self.cat_threshold)]
-        lines += [f"is_linear={int(self.is_linear)}",
-                  f"shrinkage={_fmt(self.shrinkage)}"]
+        lines += [f"is_linear={int(self.is_linear)}"]
+        if self.is_linear:
+            # reference Tree::ToString linear section (tree.cpp:377-399):
+            # flattened per-leaf feature lists / coefficients
+            nf = [len(self.leaf_features[li])
+                  if li < len(self.leaf_features) else 0
+                  for li in range(self.num_leaves)]
+            lines += [
+                "leaf_const=" + _join(self.leaf_const, _fmt),
+                "num_features=" + _join(nf),
+                "leaf_features=" + _join(
+                    [f for fl in self.leaf_features for f in fl]),
+                "leaf_coeff=" + _join(
+                    [c for cl in self.leaf_coeff for c in cl], _fmt),
+            ]
+        lines += [f"shrinkage={_fmt(self.shrinkage)}"]
         del ni
         return "\n".join(lines) + "\n\n"
 
@@ -195,12 +238,22 @@ class HostTree:
                 internal_value=np.zeros(0, np.float64),
                 internal_weight=np.zeros(0, np.float64),
                 internal_count=np.zeros(0, np.int64),
-                shrinkage=float(kv.get("shrinkage", 1)))
+                shrinkage=float(kv.get("shrinkage", 1)),
+                is_linear=bool(int(kv.get("is_linear", 0))))
         if "cat_boundaries" in kv:
             t.cat_boundaries = np.asarray(
                 kv["cat_boundaries"].split(" "), np.int64)
             t.cat_threshold = np.asarray(
                 kv["cat_threshold"].split(" "), np.uint64).astype(np.uint32)
+        if t.is_linear and "leaf_const" in kv:
+            t.leaf_const = arr("leaf_const", np.float64, nl)
+            nf = arr("num_features", np.int64, nl)
+            flat_f = arr("leaf_features", np.int64)
+            flat_c = arr("leaf_coeff", np.float64)
+            offs = np.concatenate([[0], np.cumsum(nf)]).astype(np.int64)
+            t.leaf_features = [flat_f[offs[i]:offs[i + 1]].astype(np.int32)
+                               for i in range(nl)]
+            t.leaf_coeff = [flat_c[offs[i]:offs[i + 1]] for i in range(nl)]
         return t
 
     # ---- json (Tree::ToJSON, tree.cpp:414) ----------------------------
@@ -208,10 +261,20 @@ class HostTree:
         def node(i):
             if i < 0:
                 li = ~i
-                return {"leaf_index": int(li),
-                        "leaf_value": float(self.leaf_value[li]),
-                        "leaf_weight": float(self.leaf_weight[li]),
-                        "leaf_count": int(self.leaf_count[li])}
+                d = {"leaf_index": int(li),
+                     "leaf_value": float(self.leaf_value[li]),
+                     "leaf_weight": float(self.leaf_weight[li]),
+                     "leaf_count": int(self.leaf_count[li])}
+                if self.is_linear:
+                    d["leaf_const"] = float(self.leaf_const[li]) \
+                        if li < len(self.leaf_const) else d["leaf_value"]
+                    d["leaf_features"] = [int(f) for f in (
+                        self.leaf_features[li]
+                        if li < len(self.leaf_features) else [])]
+                    d["leaf_coeff"] = [float(c) for c in (
+                        self.leaf_coeff[li]
+                        if li < len(self.leaf_coeff) else [])]
+                return d
             dt = int(self.decision_type[i])
             out = {
                 "split_index": int(i),
@@ -281,10 +344,12 @@ class HostModel:
             used_to_orig = None
             mappers = None
         model.params = {k: str(v) for k, v in cfg.raw_params.items()}
-        for tarr, cls in zip(gbdt.trees, gbdt.tree_class):
+        lins = getattr(gbdt, "linear_models", [])
+        for ti, (tarr, cls) in enumerate(zip(gbdt.trees, gbdt.tree_class)):
+            lin = lins[ti] if ti < len(lins) else None
             model.trees.append(
                 host_tree_from_arrays(tarr, used_to_orig, mappers,
-                                      float(cfg.learning_rate)))
+                                      float(cfg.learning_rate), lin=lin))
             model.tree_class.append(cls)
         return model
 
@@ -308,6 +373,9 @@ class HostModel:
                 out[:, j] = self.trees[ti].leaf_index_rows(X)
             return out
         if pred_contrib:
+            if any(t.is_linear for t in self.trees):
+                raise NotImplementedError(
+                    "pred_contrib is not supported for linear-tree models")
             return self.predict_contrib(X, start_iteration, end_iteration)
         out = np.zeros((n, k), np.float64)
         # margin-based prediction early stop (reference
@@ -425,8 +493,48 @@ class HostModel:
             new_out = -thr_g / (sum_h + l2 + 1e-15)
             t.leaf_value = decay_rate * t.leaf_value + \
                 (1.0 - decay_rate) * new_out * t.shrinkage
+            if t.is_linear:
+                # re-fit leaf linear models with decay (reference
+                # CalculateLinear is_refit path,
+                # linear_tree_learner.cpp:325-378)
+                self._refit_linear_leaves(t, X, leaves, g, h, decay_rate,
+                                          new_out, float(config.linear_lambda))
             score[:, cls] += t.predict_rows(X)
         return new_model
+
+    @staticmethod
+    def _refit_linear_leaves(t: "HostTree", X, leaves, g, h, decay,
+                             new_out, lam) -> None:
+        for li in range(t.num_leaves):
+            feats = t.leaf_features[li] if li < len(t.leaf_features) \
+                else np.zeros(0, np.int32)
+            nfeat = len(feats)
+            fb_const = decay * float(t.leaf_const[li]) + \
+                (1.0 - decay) * new_out[li] * t.shrinkage
+            if nfeat == 0:
+                t.leaf_const[li] = fb_const
+                continue
+            rows = np.flatnonzero(leaves == li)
+            xv = X[np.ix_(rows, feats)]
+            okr = ~np.isnan(xv).any(axis=1)
+            old_coef = np.asarray(t.leaf_coeff[li], np.float64)
+            if okr.sum() < nfeat + 1:
+                t.leaf_const[li] = fb_const
+                t.leaf_coeff[li] = np.zeros(nfeat)
+                continue
+            xt = np.column_stack([xv[okr], np.ones(int(okr.sum()))])
+            a = (xt * h[rows][okr][:, None]).T @ xt
+            a[np.arange(nfeat), np.arange(nfeat)] += lam
+            try:
+                sol = -np.linalg.solve(a, xt.T @ g[rows][okr])
+            except np.linalg.LinAlgError:
+                t.leaf_const[li] = fb_const
+                t.leaf_coeff[li] = np.zeros(nfeat)
+                continue
+            t.leaf_coeff[li] = decay * old_coef + \
+                (1.0 - decay) * sol[:nfeat] * t.shrinkage
+            t.leaf_const[li] = decay * float(t.leaf_const[li]) + \
+                (1.0 - decay) * sol[nfeat] * t.shrinkage
 
     # ------------------------------------------------------------------
     def to_string(self, num_iteration: Optional[int] = None,
@@ -610,7 +718,7 @@ def _feature_infos(ds) -> List[str]:
 
 
 def host_tree_from_arrays(tarr, used_to_orig: Optional[np.ndarray],
-                          mappers, shrinkage: float) -> HostTree:
+                          mappers, shrinkage: float, lin=None) -> HostTree:
     """Convert device TreeArrays (node-id space) to reference numbering."""
     nn = int(tarr.num_nodes)
     split_feature = np.asarray(tarr.split_feature)[:nn]
@@ -711,4 +819,32 @@ def host_tree_from_arrays(tarr, used_to_orig: Optional[np.ndarray],
         cat_boundaries=np.asarray(cat_boundaries, np.int64),
         cat_threshold=np.asarray(cat_threshold, np.uint32),
         shrinkage=shrinkage)
+    if lin is not None:
+        # linear leaves in leaf-rank order, original feature indices,
+        # dropping near-zero coefficients like the reference
+        # (linear_tree_learner.cpp:356-362)
+        const = np.asarray(lin.const)[:nn]
+        coeff = np.asarray(lin.coeff)[:nn]
+        lfeat = np.asarray(lin.feat)[:nn]
+        tree.is_linear = True
+        if len(leaf_ids):
+            tree.leaf_const = const[leaf_ids].astype(np.float64)
+        else:
+            tree.leaf_const = np.asarray([float(value[0])])
+        lf_list: List[np.ndarray] = []
+        lc_list: List[np.ndarray] = []
+        for nid in (leaf_ids if len(leaf_ids) else [0]):
+            fs: List[int] = []
+            cs: List[float] = []
+            for d in range(lfeat.shape[1]):
+                fu = int(lfeat[nid, d])
+                c = float(coeff[nid, d])
+                if fu >= 0 and abs(c) > _ZERO_THRESHOLD:
+                    fs.append(int(used_to_orig[fu])
+                              if used_to_orig is not None else fu)
+                    cs.append(c)
+            lf_list.append(np.asarray(fs, np.int32))
+            lc_list.append(np.asarray(cs, np.float64))
+        tree.leaf_features = lf_list
+        tree.leaf_coeff = lc_list
     return tree

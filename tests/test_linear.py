@@ -1,0 +1,130 @@
+"""Linear-tree tests (reference test_engine.py linear-tree section;
+LinearTreeLearner, src/treelearner/linear_tree_learner.cpp)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _piecewise_linear(n=4000, seed=0):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, 4).astype(np.float32)
+    y = (np.where(X[:, 0] > 0, 2.0 * X[:, 1] + 1.0, -1.5 * X[:, 1]) +
+         0.05 * r.randn(n)).astype(np.float32)
+    return X, y
+
+
+PARAMS = {"objective": "regression", "num_leaves": 8, "verbosity": -1,
+          "learning_rate": 0.3}
+
+
+class TestLinearTree:
+    def test_beats_constant_leaves_on_piecewise_linear(self):
+        X, y = _piecewise_linear()
+        b0 = lgb.train(PARAMS, lgb.Dataset(X, label=y), 40)
+        b1 = lgb.train({**PARAMS, "linear_tree": True},
+                       lgb.Dataset(X, label=y), 40)
+        mse0 = np.mean((b0.predict(X) - y) ** 2)
+        mse1 = np.mean((b1.predict(X) - y) ** 2)
+        assert mse1 < mse0 * 0.5
+
+    def test_model_text_round_trip(self):
+        X, y = _piecewise_linear()
+        b1 = lgb.train({**PARAMS, "linear_tree": True},
+                       lgb.Dataset(X, label=y), 10)
+        s = b1.model_to_string()
+        assert "is_linear=1" in s
+        assert "leaf_const=" in s and "leaf_coeff=" in s \
+            and "num_features=" in s
+        b2 = lgb.Booster(model_str=s)
+        np.testing.assert_allclose(b1.predict(X), b2.predict(X), rtol=1e-5)
+
+    def test_leaf_models_use_path_features_only(self):
+        X, y = _piecewise_linear()
+        b = lgb.train({**PARAMS, "num_leaves": 2, "learning_rate": 1.0,
+                       "linear_tree": True}, lgb.Dataset(X, label=y), 1)
+        root = b.dump_model()["tree_info"][0]["tree_structure"]
+        split_feat = root["split_feature"]
+        for side in ("left_child", "right_child"):
+            for f in root[side]["leaf_features"]:
+                assert f == split_feat
+
+    def test_nan_rows_fall_back_to_constant(self):
+        X, y = _piecewise_linear()
+        b = lgb.train({**PARAMS, "linear_tree": True},
+                      lgb.Dataset(X, label=y), 10)
+        Xn = X[:20].copy()
+        Xn[:, :] = np.nan
+        p = b.predict(Xn)
+        assert np.isfinite(p).all()
+        # all-NaN rows all route the same way -> one constant prediction
+        assert np.allclose(p, p[0])
+
+    def test_valid_set_eval(self):
+        X, y = _piecewise_linear()
+        Xv, yv = _piecewise_linear(seed=1)
+        ev = {}
+        lgb.train({**PARAMS, "linear_tree": True, "metric": "l2"},
+                  lgb.Dataset(X, label=y), 30,
+                  valid_sets=[lgb.Dataset(Xv, label=yv)],
+                  valid_names=["v"],
+                  callbacks=[lgb.record_evaluation(ev)])
+        l2 = ev["v"]["l2"]
+        assert l2[-1] < l2[0] * 0.3
+
+    def test_linear_binary_classification(self):
+        r = np.random.RandomState(2)
+        X = r.randn(3000, 5).astype(np.float32)
+        y = ((X[:, 0] * 1.5 + X[:, 1] > 0)).astype(np.float32)
+        b = lgb.train({"objective": "binary", "linear_tree": True,
+                       "num_leaves": 8, "verbosity": -1},
+                      lgb.Dataset(X, label=y), 20)
+        acc = np.mean((b.predict(X) > 0.5) == y)
+        assert acc > 0.93
+
+    def test_refit_linear(self):
+        X, y = _piecewise_linear()
+        X2, y2 = _piecewise_linear(seed=3)
+        b = lgb.train({**PARAMS, "linear_tree": True},
+                      lgb.Dataset(X, label=y), 10)
+        b2 = b.refit(X2, y2, decay_rate=0.5)
+        mse = np.mean((b2.predict(X2) - y2) ** 2)
+        assert mse < np.var(y2) * 0.5
+
+    def test_goss_conflict_raises(self):
+        X, y = _piecewise_linear(n=500)
+        with pytest.raises(ValueError):
+            lgb.train({**PARAMS, "linear_tree": True, "boosting": "goss"},
+                      lgb.Dataset(X, label=y), 2)
+
+    def test_l1_objective_conflict_raises(self):
+        X, y = _piecewise_linear(n=500)
+        with pytest.raises(ValueError):
+            lgb.train({**PARAMS, "objective": "regression_l1",
+                       "linear_tree": True}, lgb.Dataset(X, label=y), 2)
+
+    def test_pred_contrib_unsupported(self):
+        X, y = _piecewise_linear(n=500)
+        b = lgb.train({**PARAMS, "linear_tree": True},
+                      lgb.Dataset(X, label=y), 3)
+        with pytest.raises(NotImplementedError):
+            b.predict(X, pred_contrib=True)
+
+    def test_dart_linear(self):
+        X, y = _piecewise_linear()
+        b = lgb.train({**PARAMS, "linear_tree": True, "boosting": "dart",
+                       "drop_rate": 0.3, "seed": 4},
+                      lgb.Dataset(X, label=y), 25)
+        mse = np.mean((b.predict(X) - y) ** 2)
+        assert mse < np.var(y) * 0.3
+
+    def test_binary_cache_keeps_raw(self, tmp_path):
+        X, y = _piecewise_linear()
+        fn = str(tmp_path / "d.bin")
+        ds = lgb.Dataset(X, label=y, params={"linear_tree": True})
+        ds.construct()
+        ds.save_binary(fn)
+        b = lgb.train({**PARAMS, "linear_tree": True},
+                      lgb.Dataset(fn), 10)
+        assert "is_linear=1" in b.model_to_string()
