@@ -52,16 +52,17 @@ def main():
               "min_data_in_leaf": 20}
     booster = lgb.Booster(params=params, train_set=dtrain)
 
-    # warmup: compile all jitted phases
+    # warmup: compile all jitted phases. Drain via an actual host transfer
+    # (block_until_ready is not reliable through remoted-accelerator
+    # tunnels; a device->host pull cannot complete before the queue does)
     for _ in range(WARMUP_TREES):
         booster.update()
-    import jax
-    jax.block_until_ready(booster.gbdt.train_score)
+    float(np.asarray(booster.gbdt.train_score[:1])[0])
 
     t1 = time.time()
     for _ in range(BENCH_TREES):
         booster.update()
-    jax.block_until_ready(booster.gbdt.train_score)
+    float(np.asarray(booster.gbdt.train_score[:1])[0])
     dt = time.time() - t1
 
     trees_per_sec = BENCH_TREES / dt
@@ -71,6 +72,7 @@ def main():
         "unit": "trees/sec",
         "vs_baseline": round(trees_per_sec / BASELINE_TREES_PER_SEC, 3),
     }
+    import jax
     print(json.dumps(result))
     print(f"# bench detail: {BENCH_TREES} trees in {dt:.2f}s "
           f"({dt / BENCH_TREES * 1000:.1f} ms/tree), binning {bin_time:.1f}s, "

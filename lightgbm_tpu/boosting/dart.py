@@ -67,10 +67,6 @@ class DART(GBDT):
         scaled = tree._replace(leaf_value=tree.leaf_value * factor)
         return scaled
 
-    def _lin(self, idx: int):
-        return self.linear_models[idx] \
-            if idx < len(self.linear_models) else None
-
     def _apply_tree_to_scores(self, it: int, cls: int, factor: float) -> None:
         k = self.num_tree_per_iteration
         idx = it * k + cls

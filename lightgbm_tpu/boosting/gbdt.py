@@ -55,6 +55,8 @@ class GBDT:
         self.trees: List[TreeArrays] = []       # flat: iter*K + class
         self.tree_class: List[int] = []
         self.linear_models: List = []           # LinearLeaves or None, per tree
+        self._pending_nleaves = None            # device scalar, lag-1 poll
+        self._exact_stop_poll = False
         self.models_meta: List[dict] = []       # host-side per-tree info
         self.valid_sets: List[BinnedDataset] = []
         self.valid_names: List[str] = []
@@ -138,14 +140,23 @@ class GBDT:
                         "tree learners yet; ignoring cegb_* parameters")
             self._cegb_cfg = None
             self._cegb_state = None
-        # Pallas MXU histogram kernel on TPU-like backends (serial learner;
-        # the sharded path keeps the portable scatter fallback for now)
+        # TPU kernel choice (serial learner; the sharded path keeps the
+        # portable scatter fallback for now): "mxu" = sort/gather-free
+        # one-hot-matmul growth (grower_mxu.py), "pallas" = grouped-rows
+        # histogram kernel, "scatter" = pure-XLA segment adds
         backend = jax.default_backend()
-        self._hist_impl = "pallas" if (
-            cfg.use_pallas and self._grower is None and
-            backend not in ("cpu",)) else "scatter"
-        if self._hist_impl == "pallas":
-            Log.debug("Using Pallas histogram kernel (backend=%s)", backend)
+        if cfg.use_pallas and self._grower is None and backend != "cpu":
+            # the mxu kernels carry bin values through bf16 matmul
+            # operands, exact only for max_bin <= 256
+            if self._forced is None and self._cegb_cfg is None and \
+                    self.bmax <= 256:
+                self._hist_impl = "mxu"
+            else:
+                self._hist_impl = "pallas"
+        else:
+            self._hist_impl = "scatter"
+        Log.debug("Tree kernel path: %s (backend=%s)", self._hist_impl,
+                  backend)
         # linear trees (reference LinearTreeLearner; raw values required,
         # dataset.cpp:418-420)
         self._linear = bool(cfg.linear_tree)
@@ -302,6 +313,16 @@ class GBDT:
         rng_key = jax.random.fold_in(
             jax.random.PRNGKey(cfg.extra_seed), self.iter_) \
             if needs_rng else None
+        if self._grower is None and self._hist_impl == "mxu":
+            from ..learner.grower_mxu import grow_tree_mxu
+            return grow_tree_mxu(
+                self.bins, g, h, cnt, feature_mask, self.num_bins_d,
+                self.missing_is_nan_d, self.is_cat_d,
+                num_leaves=cfg.num_leaves, max_depth=cfg.max_depth,
+                hp=self.hp, bmax=self.bmax, monotone=self._monotone,
+                interaction_groups=self._interaction_groups,
+                feature_fraction_bynode=cfg.feature_fraction_bynode,
+                rng_key=rng_key)
         if self._grower is None:
             out = grow_tree(
                 self.bins, g, h, cnt, feature_mask, self.num_bins_d,
@@ -365,9 +386,7 @@ class GBDT:
             self.valid_raws.append(None)
         # replay existing model on the new valid set
         for ti, (t, cls) in enumerate(zip(self.trees, self.tree_class)):
-            lin = self.linear_models[ti] \
-                if ti < len(self.linear_models) else None
-            vals = self._tree_values(t, lin, self.valid_bins[-1],
+            vals = self._tree_values(t, self._lin(ti), self.valid_bins[-1],
                                      self.valid_raws[-1])
             vi = len(self.valid_scores) - 1
             if k == 1:
@@ -458,7 +477,21 @@ class GBDT:
             with global_timer.timeit("tree_train"):
                 feature_mask = self._feature_mask()
                 tree, row_node = self._grow(g, h, cnt, feature_mask)
-            nleaves = int(tree.num_leaves)
+            # a host pull of num_leaves costs a full device round-trip
+            # (~hundreds of ms through a remoted accelerator). Instead of
+            # syncing on the fresh tree, check the PREVIOUS iteration's
+            # count (its pull overlaps this iteration's device work), so
+            # training stops at most one all-zero iteration late; no-split
+            # trees are neutralized DEVICE-side (leaf values zeroed below)
+            # so that lag is harmless for score sums. Subclasses that
+            # average over iteration count (RF) set _exact_stop_poll to
+            # keep the reference's immediate stop.
+            if len(self.trees) < k or self._exact_stop_poll:
+                nleaves = int(tree.num_leaves)
+            else:
+                prev = self._pending_nleaves
+                nleaves = 2 if prev is None else int(prev)
+            self._pending_nleaves = tree.num_leaves
             lin = None
             if nleaves > 1:
                 should_continue = True
@@ -480,13 +513,16 @@ class GBDT:
                             jnp.float32(cfg.linear_lambda),
                             dmax=self._lin_dmax)
                 # shrinkage (tree.cpp Shrinkage): scale leaf outputs and,
-                # for linear leaves, consts + coefficients
+                # for linear leaves, consts + coefficients. The `ok`
+                # factor zeroes trees that made no split (device-side
+                # stand-in for the reference's "no further splits" break)
+                ok = (tree.num_leaves > 1).astype(jnp.float32)
                 tree = tree._replace(
-                    leaf_value=tree.leaf_value * self.shrinkage_rate)
+                    leaf_value=tree.leaf_value * self.shrinkage_rate * ok)
                 if lin is not None:
                     lin = lin._replace(
-                        const=lin.const * self.shrinkage_rate,
-                        coeff=lin.coeff * self.shrinkage_rate)
+                        const=lin.const * self.shrinkage_rate * ok,
+                        coeff=lin.coeff * self.shrinkage_rate * ok)
                 with global_timer.timeit("update_score"):
                     self._update_score(tree, row_node, cls, lin)
                 if abs(init_scores[cls]) > 1e-35:
@@ -569,6 +605,11 @@ class GBDT:
                 self.valid_scores[i] = \
                     self.valid_scores[i].at[:, cls].add(value)
 
+    def _lin(self, idx: int):
+        """Linear leaf model of tree idx (None for constant leaves)."""
+        return self.linear_models[idx] \
+            if idx < len(self.linear_models) else None
+
     def _tree_values(self, tree: TreeArrays, lin, bins: jax.Array,
                      raw) -> jax.Array:
         """Per-row outputs of one tree on a binned matrix (linear-aware)."""
@@ -586,7 +627,13 @@ class GBDT:
         """Learner-side score update: leaf value via row->node gather
         (score_updater.hpp:21-110 AddScore(tree_learner) equivalent)."""
         if lin is None:
-            vals = tree.leaf_value[row_node]
+            if self._hist_impl == "mxu":
+                # per-row gathers are ~10M rows/s on remoted TPUs; the
+                # one-hot matmul lookup kernel is ~50x faster
+                from ..learner.histogram_mxu import node_values_mxu
+                vals = node_values_mxu(row_node, tree.leaf_value)
+            else:
+                vals = tree.leaf_value[row_node]
         else:
             from ..learner.linear import linear_leaf_values
             vals = linear_leaf_values(tree, lin, row_node, self.raw)

@@ -23,6 +23,9 @@ class RF(GBDT):
         super().__init__(config, train_set, objective, metrics)
         self.shrinkage_rate = 1.0
         self._init_scores = [0.0] * self.num_tree_per_iteration
+        # RF averages scores over iteration count, so late-appended
+        # zero trees would bias every prediction — poll exactly
+        self._exact_stop_poll = True
 
     def _boost_from_average(self, cls: int) -> float:
         # RF boosts from the average ONCE and keeps gradients at that point
