@@ -479,22 +479,27 @@ class GBDT:
                 tree, row_node = self._grow(g, h, cnt, feature_mask)
             # a host pull of num_leaves costs a full device round-trip
             # (~hundreds of ms through a remoted accelerator). Instead of
-            # syncing on the fresh tree, check the PREVIOUS iteration's
-            # count (its pull overlaps this iteration's device work), so
-            # training stops at most one all-zero iteration late; no-split
-            # trees are neutralized DEVICE-side (leaf values zeroed below)
-            # so that lag is harmless for score sums. Subclasses that
-            # average over iteration count (RF) set _exact_stop_poll to
-            # keep the reference's immediate stop.
+            # syncing on the fresh tree, the stop decision reads the
+            # PREVIOUS iteration's count (its pull overlaps this
+            # iteration's device work). The fresh tree always takes the
+            # normal processing branch — shrinkage, score update, and the
+            # device-side `ok` zeroing make a genuine no-split tree a
+            # harmless all-zero tree, while a real tree (possible after a
+            # dry iteration when bagging resamples) stays fully applied.
+            # Subclasses that average over iteration count (RF) set
+            # _exact_stop_poll to keep the reference's immediate stop.
             if len(self.trees) < k or self._exact_stop_poll:
                 nleaves = int(tree.num_leaves)
+                stop_hint = nleaves <= 1
             else:
                 prev = self._pending_nleaves
-                nleaves = 2 if prev is None else int(prev)
+                stop_hint = prev is not None and int(prev) <= 1
+                nleaves = 2
             self._pending_nleaves = tree.num_leaves
             lin = None
             if nleaves > 1:
-                should_continue = True
+                if not stop_hint:
+                    should_continue = True
                 if self.objective is not None and \
                         self.objective.need_renew_tree_output:
                     rw = cnt if self.objective.weight is None \

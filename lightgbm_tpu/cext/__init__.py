@@ -23,15 +23,37 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _build() -> bool:
+def _load_or_build(src: str, lib_path: str,
+                   flag_sets=(())) -> Optional[ctypes.CDLL]:
+    """Load lib_path, rebuilding from src when stale; None on failure.
+
+    Degrades gracefully: a missing source next to a prebuilt .so loads
+    the .so; no compiler at all returns None (NumPy fallbacks take over).
+    """
+    have_src = os.path.exists(src)
+    stale = have_src and (
+        not os.path.exists(lib_path) or
+        os.path.getmtime(lib_path) < os.path.getmtime(src))
+    if stale:
+        built = False
+        for flags in flag_sets:
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17"]
+                    + list(flags) + [src, "-o", lib_path],
+                    check=True, capture_output=True, timeout=120)
+                built = True
+                break
+            except Exception:
+                continue
+        if not built:
+            return None
+    if not os.path.exists(lib_path):
+        return None
     try:
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
-             "-o", _LIB_PATH],
-            check=True, capture_output=True, timeout=120)
-        return True
-    except Exception:
-        return False
+        return ctypes.CDLL(lib_path)
+    except OSError:
+        return None
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
@@ -39,13 +61,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if not os.path.exists(_LIB_PATH) or \
-            os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC):
-        if not _build():
-            return None
-    try:
-        lib = ctypes.CDLL(_LIB_PATH)
-    except OSError:
+    lib = _load_or_build(_SRC, _LIB_PATH, flag_sets=((),))
+    if lib is None:
         return None
     c_dp = ctypes.POINTER(ctypes.c_double)
     c_ip = ctypes.POINTER(ctypes.c_int)
@@ -140,4 +157,109 @@ def values_to_bins_u8(values: np.ndarray, bounds: np.ndarray,
         bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         num_search, nan_bin,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# native forest predictor (predict.cpp; reference predictor.hpp:30)
+# ---------------------------------------------------------------------------
+
+_PSRC = os.path.join(_DIR, "predict.cpp")
+_PLIB_PATH = os.path.join(_DIR, "libpredict.so")
+_plib: Optional[ctypes.CDLL] = None
+_ptried = False
+
+
+def get_predict_lib() -> Optional[ctypes.CDLL]:
+    global _plib, _ptried
+    if _plib is not None or _ptried:
+        return _plib
+    _ptried = True
+    lib = _load_or_build(_PSRC, _PLIB_PATH,
+                         flag_sets=(("-fopenmp",), ()))
+    if lib is None:
+        return None
+    c_dp = ctypes.POINTER(ctypes.c_double)
+    c_ip = ctypes.POINTER(ctypes.c_int)
+    c_lp = ctypes.POINTER(ctypes.c_long)
+    c_u8 = ctypes.POINTER(ctypes.c_uint8)
+    c_u32 = ctypes.POINTER(ctypes.c_uint32)
+    lib.lgbt_predict.restype = None
+    lib.lgbt_predict.argtypes = [
+        c_dp, ctypes.c_long, ctypes.c_int, ctypes.c_int, c_ip, ctypes.c_int,
+        c_lp, c_lp, c_ip, c_dp, c_u8, c_ip, c_ip, c_dp,
+        c_lp, c_lp, c_u32, c_lp,
+        c_u8, c_dp, c_lp, c_ip, c_dp,
+        ctypes.c_int, ctypes.c_int, c_dp]
+    lib.lgbt_predict_leaf.restype = None
+    lib.lgbt_predict_leaf.argtypes = [
+        c_dp, ctypes.c_long, ctypes.c_int, ctypes.c_int,
+        c_lp, c_lp, c_ip, c_dp, c_u8, c_ip, c_ip,
+        c_lp, c_lp, c_u32, c_lp,
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+    _plib = lib
+    return _plib
+
+
+def predict_available() -> bool:
+    return get_predict_lib() is not None
+
+
+def _ptr(a, ct):
+    return a.ctypes.data_as(ctypes.POINTER(ct))
+
+
+def forest_predict(flat: dict, X: np.ndarray, k: int, start_tree: int,
+                   end_tree: int) -> np.ndarray:
+    """Run the native predictor over trees [start_tree, end_tree)."""
+    lib = get_predict_lib()
+    assert lib is not None
+    X = np.ascontiguousarray(X, np.float64)
+    n, nfeat = X.shape
+    out = np.zeros((n, k), np.float64)
+    lib.lgbt_predict(
+        _ptr(X, ctypes.c_double), n, nfeat, flat["num_trees"],
+        _ptr(flat["tree_class"], ctypes.c_int), k,
+        _ptr(flat["node_off"], ctypes.c_long),
+        _ptr(flat["leaf_off"], ctypes.c_long),
+        _ptr(flat["split_feature"], ctypes.c_int),
+        _ptr(flat["threshold"], ctypes.c_double),
+        _ptr(flat["decision_type"], ctypes.c_uint8),
+        _ptr(flat["left"], ctypes.c_int),
+        _ptr(flat["right"], ctypes.c_int),
+        _ptr(flat["leaf_value"], ctypes.c_double),
+        _ptr(flat["catb_off"], ctypes.c_long),
+        _ptr(flat["cat_boundaries"], ctypes.c_long),
+        _ptr(flat["cat_threshold"], ctypes.c_uint32),
+        _ptr(flat["catt_off"], ctypes.c_long),
+        _ptr(flat["is_linear"], ctypes.c_uint8),
+        _ptr(flat["leaf_const"], ctypes.c_double),
+        _ptr(flat["lfeat_off"], ctypes.c_long),
+        _ptr(flat["leaf_features"], ctypes.c_int),
+        _ptr(flat["leaf_coeff"], ctypes.c_double),
+        start_tree, end_tree, _ptr(out, ctypes.c_double))
+    return out
+
+
+def forest_predict_leaf(flat: dict, X: np.ndarray, start_tree: int,
+                        end_tree: int) -> np.ndarray:
+    lib = get_predict_lib()
+    assert lib is not None
+    X = np.ascontiguousarray(X, np.float64)
+    n, nfeat = X.shape
+    out = np.zeros((n, end_tree - start_tree), np.int32)
+    lib.lgbt_predict_leaf(
+        _ptr(X, ctypes.c_double), n, nfeat, flat["num_trees"],
+        _ptr(flat["node_off"], ctypes.c_long),
+        _ptr(flat["leaf_off"], ctypes.c_long),
+        _ptr(flat["split_feature"], ctypes.c_int),
+        _ptr(flat["threshold"], ctypes.c_double),
+        _ptr(flat["decision_type"], ctypes.c_uint8),
+        _ptr(flat["left"], ctypes.c_int),
+        _ptr(flat["right"], ctypes.c_int),
+        _ptr(flat["catb_off"], ctypes.c_long),
+        _ptr(flat["cat_boundaries"], ctypes.c_long),
+        _ptr(flat["cat_threshold"], ctypes.c_uint32),
+        _ptr(flat["catt_off"], ctypes.c_long),
+        start_tree, end_tree, _ptr(out, ctypes.c_int))
     return out

@@ -1,0 +1,134 @@
+"""Dask-style distributed estimators (reference python-package/lightgbm/
+dask.py:393+ DaskLGBMClassifier/Regressor/Ranker).
+
+The reference's Dask integration exists to stitch a TCP socket mesh between
+workers and run the data-parallel socket learner on each partition
+(dask.py:68-135 port probing, :167-184 machines-param injection). On TPU
+that whole transport layer is replaced by XLA collectives over ICI/DCN: a
+single process drives all local chips through `jax.sharding`
+(tree_learner=data, parallel/learner.py), and multi-host scaling uses
+`jax.distributed.initialize` + the same sharded learner instead of a Dask
+scheduler.
+
+These wrappers keep the reference's API shape for drop-in compatibility:
+- with dask installed, Dask collections are concatenated to the driver and
+  trained on the sharded-TPU learner (the mesh replaces worker fan-out);
+- without dask, constructing an estimator raises the same ImportError the
+  reference raises when dask is missing (dask.py:24-30).
+
+Cite: reference dask.py:393 (_train), :811 (_predict_part), :1060+
+(estimator classes).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .sklearn import LGBMClassifier, LGBMRanker, LGBMRegressor
+
+__all__ = ["DaskLGBMClassifier", "DaskLGBMRegressor", "DaskLGBMRanker"]
+
+try:  # pragma: no cover - environment dependent
+    import dask.array  # noqa: F401
+    import dask.dataframe  # noqa: F401
+    _DASK_AVAILABLE = True
+except ImportError:
+    _DASK_AVAILABLE = False
+
+
+def _concat_to_local(part):
+    """Materialize a Dask collection on the driver.
+
+    The reference trains per-worker on local partitions and relies on its
+    socket collectives for the merge; the TPU learner shards rows over the
+    device mesh instead, so data is gathered once and device-sharded
+    (parallel/learner.py 'data' mode)."""
+    import dask.array as da
+    import dask.dataframe as dd
+    import numpy as np
+    if isinstance(part, da.Array):
+        return part.compute()
+    if isinstance(part, (dd.DataFrame, dd.Series)):
+        return part.compute().to_numpy()
+    return np.asarray(part)
+
+
+class _DaskBase:
+    _local_cls: Any = None
+
+    def __init__(self, client: Optional[Any] = None, **kwargs):
+        if not _DASK_AVAILABLE:
+            raise ImportError(
+                "dask is required for DaskLGBM estimators; install dask "
+                "and distributed, or use the plain sklearn estimators — "
+                "on TPU the device mesh already provides distributed "
+                "training (tree_learner=data)")
+        self._client = client
+        params = dict(kwargs)
+        # the TPU mesh replaces the reference's per-worker socket learner
+        params.setdefault("tree_learner", "data")
+        self._local = self._local_cls(**params)
+
+    # -- fit/predict keep the reference signatures (dask.py:1060+) -----
+    def fit(self, X, y, sample_weight=None, group=None, **kwargs):
+        Xl = _concat_to_local(X)
+        yl = _concat_to_local(y)
+        sw = None if sample_weight is None else _concat_to_local(
+            sample_weight)
+        fit_kwargs = dict(kwargs)
+        if group is not None:
+            fit_kwargs["group"] = _concat_to_local(group)
+        self._local.fit(Xl, yl, sample_weight=sw, **fit_kwargs)
+        return self
+
+    def predict(self, X, **kwargs):
+        import dask.array as da
+        if isinstance(X, da.Array):
+            # distributed predict via map_blocks (reference _predict_part,
+            # dask.py:811): each partition scored independently
+            model = self._local
+
+            def _part(block):
+                return model.predict(block, **kwargs)
+
+            out = X.map_blocks(_part, drop_axis=tuple(range(1, X.ndim)))
+            return out
+        return self._local.predict(_concat_to_local(X), **kwargs)
+
+    def predict_proba(self, X, **kwargs):
+        import dask.array as da
+        if isinstance(X, da.Array):
+            model = self._local
+
+            def _part(block):
+                return model.predict_proba(block, **kwargs)
+
+            return X.map_blocks(_part)
+        return self._local.predict_proba(_concat_to_local(X), **kwargs)
+
+    def __getattr__(self, name):
+        # delegate attributes (booster_, feature_importances_, ...) to the
+        # wrapped local estimator
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self._local, name)
+
+    def to_local(self):
+        """Return the underlying single-process estimator (reference
+        DaskLGBMModel.to_local, dask.py:900+)."""
+        return self._local
+
+
+class DaskLGBMClassifier(_DaskBase):
+    """Distributed classifier (reference dask.py:1060)."""
+    _local_cls = LGBMClassifier
+
+
+class DaskLGBMRegressor(_DaskBase):
+    """Distributed regressor (reference dask.py:1230)."""
+    _local_cls = LGBMRegressor
+
+
+class DaskLGBMRanker(_DaskBase):
+    """Distributed ranker (reference dask.py:1380)."""
+    _local_cls = LGBMRanker

@@ -30,16 +30,12 @@ import jax
 import jax.numpy as jnp
 
 from .grower import _init_tree, TreeArrays
-from .histogram_mxu import (build_histograms_mxu, pack_route_tables,
-                            route_rows_mxu)
+from .histogram_mxu import (_round_up, build_histograms_mxu,
+                            pack_route_tables, route_rows_mxu)
 from .split import (BestSplits, SplitHyperParams, find_best_splits,
                     leaf_output)
 
 __all__ = ["grow_tree_mxu"]
-
-
-def _round_up(x: int, k: int) -> int:
-    return ((x + k - 1) // k) * k
 
 
 @functools.partial(

@@ -47,6 +47,10 @@ __all__ = ["build_histograms_mxu", "route_rows_mxu", "pack_route_tables",
 _COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
 
 
+def _round_up(x: int, k: int) -> int:
+    return ((x + k - 1) // k) * k
+
+
 # ---------------------------------------------------------------------------
 # histogram
 # ---------------------------------------------------------------------------
@@ -394,7 +398,7 @@ def node_values_mxu(row_node: jax.Array, values: jax.Array, *,
     matmul (score updates, reference score_updater.hpp:21-110)."""
     n = row_node.shape[0]
     m1 = values.shape[0]
-    m = _round_up_mxu(m1, 128)
+    m = _round_up(m1, 128)
     # unlike a gather, the one-hot contraction touches EVERY table entry
     # (0 * NaN = NaN would poison all rows); never-referenced rows such as
     # the grower's scratch node can hold NaN, so sanitize first
@@ -423,6 +427,3 @@ def node_values_mxu(row_node: jax.Array, values: jax.Array, *,
     )(node[:, None], tbl)
     return out[:n, 0]
 
-
-def _round_up_mxu(x: int, k: int) -> int:
-    return ((x + k - 1) // k) * k

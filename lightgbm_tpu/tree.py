@@ -321,6 +321,78 @@ class HostModel:
     def num_iterations(self) -> int:
         return len(self.trees) // max(self.num_tree_per_iteration, 1)
 
+    # ---- native predictor (cext/predict.cpp; predictor.hpp:30) --------
+    def _flatten_native(self):
+        """Flatten the forest into the concatenated arrays the C
+        predictor consumes; cached until the tree list changes."""
+        cached = getattr(self, "_native_flat", None)
+        if cached is not None and cached["num_trees"] == len(self.trees):
+            return cached
+        t_list = self.trees
+        k = max(self.num_tree_per_iteration, 1)
+        node_off = np.zeros(len(t_list) + 1, np.int64)
+        leaf_off = np.zeros(len(t_list) + 1, np.int64)
+        catb_off = np.zeros(len(t_list) + 1, np.int64)
+        catt_off = np.zeros(len(t_list) + 1, np.int64)
+        for i, t in enumerate(t_list):
+            node_off[i + 1] = node_off[i] + max(t.num_leaves - 1, 0)
+            leaf_off[i + 1] = leaf_off[i] + t.num_leaves
+            catb_off[i + 1] = catb_off[i] + len(t.cat_boundaries)
+            catt_off[i + 1] = catt_off[i] + len(t.cat_threshold)
+
+        def cat(key, dtype):
+            parts = [np.asarray(getattr(t, key), dtype) for t in t_list]
+            return np.ascontiguousarray(np.concatenate(parts)) if parts \
+                else np.zeros(0, dtype)
+
+        nl_total = int(leaf_off[-1])
+        lconst = np.zeros(nl_total, np.float64)
+        lfeat_off = np.zeros(nl_total + 1, np.int64)
+        lfeats: List[np.ndarray] = []
+        lcoefs: List[np.ndarray] = []
+        pos = 0
+        for i, t in enumerate(t_list):
+            for li in range(t.num_leaves):
+                gi = int(leaf_off[i]) + li
+                if t.is_linear and li < len(t.leaf_const):
+                    lconst[gi] = t.leaf_const[li]
+                    feats = t.leaf_features[li] \
+                        if li < len(t.leaf_features) else []
+                    pos += len(feats)
+                    lfeats.append(np.asarray(feats, np.int32))
+                    lcoefs.append(np.asarray(
+                        t.leaf_coeff[li] if li < len(t.leaf_coeff) else [],
+                        np.float64))
+                lfeat_off[gi + 1] = pos
+        flat = {
+            "num_trees": len(t_list),
+            "tree_class": np.ascontiguousarray(
+                [self.tree_class[i] if i < len(self.tree_class) else i % k
+                 for i in range(len(t_list))], np.int32),
+            "node_off": node_off, "leaf_off": leaf_off,
+            "split_feature": cat("split_feature", np.int32),
+            "threshold": cat("threshold", np.float64),
+            "decision_type": cat("decision_type", np.uint8),
+            "left": cat("left_child", np.int32),
+            "right": cat("right_child", np.int32),
+            "leaf_value": cat("leaf_value", np.float64),
+            "catb_off": catb_off, "catt_off": catt_off,
+            "cat_boundaries": cat("cat_boundaries", np.int64),
+            "cat_threshold": cat("cat_threshold", np.uint32),
+            "is_linear": np.ascontiguousarray(
+                [int(t.is_linear) for t in t_list], np.uint8),
+            "leaf_const": lconst,
+            "lfeat_off": lfeat_off,
+            "leaf_features": np.ascontiguousarray(
+                np.concatenate(lfeats), np.int32) if lfeats
+            else np.zeros(0, np.int32),
+            "leaf_coeff": np.ascontiguousarray(
+                np.concatenate(lcoefs), np.float64) if lcoefs
+            else np.zeros(0, np.float64),
+        }
+        self._native_flat = flat
+        return flat
+
     # ------------------------------------------------------------------
     @staticmethod
     def from_gbdt(gbdt, train_dataset) -> "HostModel":
@@ -367,7 +439,14 @@ class HostModel:
         end_iteration = min(start_iteration + num_iteration, total_iters)
         rng = range(start_iteration * k, end_iteration * k)
         n = X.shape[0]
+        from .cext import predict_available
+        use_native = predict_available()
         if pred_leaf:
+            if use_native:
+                from .cext import forest_predict_leaf
+                return forest_predict_leaf(
+                    self._flatten_native(), X, start_iteration * k,
+                    end_iteration * k)
             out = np.zeros((n, len(rng)), np.int32)
             for j, ti in enumerate(rng):
                 out[:, j] = self.trees[ti].leaf_index_rows(X)
@@ -386,6 +465,16 @@ class HostModel:
         use_early = (pred_early_stop and not self.average_output and
                      (k > 1 or obj in ("binary", "cross_entropy",
                                        "xentropy")))
+        if use_native and not use_early:
+            # native OMP predictor (cext/predict.cpp, predictor.hpp:30)
+            from .cext import forest_predict
+            out = forest_predict(self._flatten_native(), X, k,
+                                 start_iteration * k, end_iteration * k)
+            if self.average_output:
+                out /= max(end_iteration - start_iteration, 1)
+            if not raw_score:
+                out = self._convert_output(out)
+            return out[:, 0] if k == 1 else out
         # checks happen on iteration boundaries only, so every class has an
         # equal tree count when a row is retired; rows are re-sliced only
         # when the active set changes (at a check), not per tree

@@ -1,0 +1,99 @@
+"""Native C++ layer tests: forest predictor parity (cext/predict.cpp)
+and the Dask wrapper surface (dask.py)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+import lightgbm_tpu.cext as cext
+
+
+def _toggle_numpy_path(bst):
+    """Force the numpy prediction path for comparison."""
+    class _Ctx:
+        def __enter__(self):
+            self._orig = cext.predict_available
+            cext.predict_available = lambda: False
+            bst._model = None
+
+        def __exit__(self, *a):
+            cext.predict_available = self._orig
+            bst._model = None
+    return _Ctx()
+
+
+@pytest.mark.skipif(not cext.predict_available(),
+                    reason="no native compiler")
+class TestNativePredictor:
+    def _model(self):
+        rng = np.random.RandomState(0)
+        X = rng.randn(8000, 8).astype(np.float32)
+        X[rng.rand(8000) < 0.05, 2] = np.nan
+        X[:, 3] = rng.randint(0, 10, 8000)
+        y = (np.nan_to_num(X[:, 0]) + 0.5 * X[:, 1] +
+             (X[:, 3] > 5) > 0.5).astype(np.float32)
+        bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                         "verbosity": -1},
+                        lgb.Dataset(X, label=y, categorical_feature=[3]),
+                        20)
+        return bst, X
+
+    def test_matches_numpy_path(self):
+        bst, X = self._model()
+        p_native = bst.predict(X)
+        with _toggle_numpy_path(bst):
+            p_numpy = bst.predict(X)
+        np.testing.assert_allclose(p_native, p_numpy, rtol=1e-10)
+
+    def test_leaf_index_matches(self):
+        bst, X = self._model()
+        l_native = bst.predict(X, pred_leaf=True)
+        with _toggle_numpy_path(bst):
+            l_numpy = bst.predict(X, pred_leaf=True)
+        np.testing.assert_array_equal(l_native, l_numpy)
+
+    def test_multiclass(self):
+        rng = np.random.RandomState(1)
+        X = rng.randn(5000, 6).astype(np.float32)
+        y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)
+        bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                         "verbosity": -1}, lgb.Dataset(X, label=y), 10)
+        p_native = bst.predict(X)
+        with _toggle_numpy_path(bst):
+            p_numpy = bst.predict(X)
+        np.testing.assert_allclose(p_native, p_numpy, rtol=1e-10)
+
+    def test_linear_trees(self):
+        rng = np.random.RandomState(2)
+        X = rng.randn(4000, 4).astype(np.float32)
+        y = np.where(X[:, 0] > 0, 2 * X[:, 1], -X[:, 1]).astype(np.float32)
+        bst = lgb.train({"objective": "regression", "num_leaves": 8,
+                         "linear_tree": True, "verbosity": -1},
+                        lgb.Dataset(X, label=y), 10)
+        p_native = bst.predict(X)
+        with _toggle_numpy_path(bst):
+            p_numpy = bst.predict(X)
+        np.testing.assert_allclose(p_native, p_numpy, rtol=1e-8)
+
+    def test_start_num_iteration(self):
+        bst, X = self._model()
+        p_native = bst.predict(X, start_iteration=5, num_iteration=10)
+        with _toggle_numpy_path(bst):
+            p_numpy = bst.predict(X, start_iteration=5, num_iteration=10)
+        np.testing.assert_allclose(p_native, p_numpy, rtol=1e-10)
+
+
+class TestDaskSurface:
+    def test_estimators_importable(self):
+        from lightgbm_tpu.dask import (DaskLGBMClassifier,
+                                       DaskLGBMRanker, DaskLGBMRegressor)
+        assert DaskLGBMClassifier is not None
+        assert DaskLGBMRegressor is not None
+        assert DaskLGBMRanker is not None
+
+    def test_raises_without_dask(self):
+        from lightgbm_tpu import dask as lgb_dask
+        if lgb_dask._DASK_AVAILABLE:
+            pytest.skip("dask installed")
+        with pytest.raises(ImportError):
+            lgb_dask.DaskLGBMClassifier(n_estimators=5)
