@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Generate docs/Parameters.md from the config registry.
+
+The reference inverts this: docs/Parameters.rst is the source of truth and
+helpers/parameter_generator.py emits src/io/config_auto.cpp from it. Here
+the typed registry in lightgbm_tpu/config.py is the source of truth and
+this script emits the docs, keeping the same single-source guarantee.
+
+Run from the repo root:  python helpers/generate_parameter_docs.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from lightgbm_tpu.config import _PARAMS  # noqa: E402
+
+
+def main() -> None:
+    lines = [
+        "# Parameters",
+        "",
+        "Generated from the typed parameter registry "
+        "(`lightgbm_tpu/config.py`) by `helpers/generate_parameter_docs.py`"
+        " — do not edit by hand.",
+        "",
+        "Parameters are accepted as `key=value` pairs on the CLI / in "
+        "config files, and as dict entries in the Python API. Aliases "
+        "resolve to the canonical name (first match wins, like the "
+        "reference alias table `config_auto.cpp:10`).",
+        "",
+        "| Parameter | Type | Default | Aliases |",
+        "|---|---|---|---|",
+    ]
+    for spec in _PARAMS:
+        tname = getattr(spec.type, "__name__", str(spec.type))
+        default = repr(spec.default) if spec.default != "" else '""'
+        aliases = ", ".join(f"`{a}`" for a in spec.aliases) or "—"
+        lines.append(f"| `{spec.name}` | {tname} | {default} | {aliases} |")
+    lines.append("")
+    lines.append(f"Total: {len(_PARAMS)} parameters.")
+    lines.append("")
+    out = os.path.join(os.path.dirname(__file__), "..", "docs",
+                       "Parameters.md")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as fh:
+        fh.write("\n".join(lines))
+    print(f"wrote {out} ({len(_PARAMS)} parameters)")
+
+
+if __name__ == "__main__":
+    main()

@@ -355,7 +355,11 @@ class Booster:
 
     def current_iteration(self) -> int:
         if self.gbdt is not None:
-            return self.gbdt.current_iteration()
+            n = self.gbdt.current_iteration()
+            base = getattr(self, "_base_model", None)
+            if base is not None:
+                n += base.current_iteration()  # continued training
+            return n
         return self._model.num_iterations if self._model else 0
 
     @property
@@ -369,7 +373,11 @@ class Booster:
 
     def num_trees(self) -> int:
         if self.gbdt is not None:
-            return len(self.gbdt.trees)
+            n = len(self.gbdt.trees)
+            base = getattr(self, "_base_model", None)
+            if base is not None:
+                n += base.num_trees()   # continued training keeps base trees
+            return n
         return len(self._model.trees) if self._model else 0
 
     # ------------------------------------------------------------------
@@ -415,7 +423,20 @@ class Booster:
     def _host_model(self):
         from .tree import HostModel
         if self._model is None:
-            self._model = HostModel.from_gbdt(self.gbdt, self.train_set)
+            model = HostModel.from_gbdt(self.gbdt, self.train_set)
+            base = getattr(self, "_base_model", None)
+            if base is not None:
+                # continued training: the saved/served model keeps the
+                # base model's trees in front of the new ones (reference
+                # Booster(model_file=...) + train semantics)
+                bm = base._host_model()
+                model.trees = list(bm.trees) + model.trees
+                model.tree_class = list(bm.tree_class) + model.tree_class
+                if not model.feature_names and bm.feature_names:
+                    model.feature_names = bm.feature_names
+                    model.feature_infos = bm.feature_infos
+                    model.max_feature_idx = bm.max_feature_idx
+            self._model = model
         return self._model
 
     def predict(self, data, start_iteration: int = 0,

@@ -24,7 +24,7 @@ _tried = False
 
 
 def _load_or_build(src: str, lib_path: str,
-                   flag_sets=(())) -> Optional[ctypes.CDLL]:
+                   flag_sets=((),)) -> Optional[ctypes.CDLL]:
     """Load lib_path, rebuilding from src when stale; None on failure.
 
     Degrades gracefully: a missing source next to a prebuilt .so loads

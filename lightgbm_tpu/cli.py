@@ -157,11 +157,26 @@ class Application:
                                           reference=dtrain))
                 valid_names.append(f"valid_{i + 1}")
         callbacks = [log_evaluation(cfg.metric_freq)]
+        if cfg.snapshot_freq > 0:
+            # periodic model snapshots (reference gbdt.cpp:279-283:
+            # "snapshot_iter_<n>" files every snapshot_freq iterations)
+            out_model = cfg.output_model
+
+            def _snapshot(env):
+                it = env.iteration + 1
+                if it % cfg.snapshot_freq == 0:
+                    path = f"{out_model}.snapshot_iter_{it}"
+                    env.model.save_model(path)
+                    Log.info("Saved snapshot to %s", path)
+
+            callbacks.append(_snapshot)
+        init_model = cfg.input_model if cfg.input_model else None
         booster = train_fn(dict(self.params), dtrain,
                            num_boost_round=cfg.num_iterations,
                            valid_sets=valid_sets or None,
                            valid_names=valid_names or None,
-                           callbacks=callbacks)
+                           callbacks=callbacks,
+                           init_model=init_model)
         booster.save_model(cfg.output_model)
         Log.info("Finished training, model saved to %s", cfg.output_model)
 

@@ -82,28 +82,14 @@ class _DaskBase:
         return self
 
     def predict(self, X, **kwargs):
-        import dask.array as da
-        if isinstance(X, da.Array):
-            # distributed predict via map_blocks (reference _predict_part,
-            # dask.py:811): each partition scored independently
-            model = self._local
-
-            def _part(block):
-                return model.predict(block, **kwargs)
-
-            out = X.map_blocks(_part, drop_axis=tuple(range(1, X.ndim)))
-            return out
+        # partitions are scored on the driver against the local model (the
+        # reference's per-worker _predict_part, dask.py:811, exists to
+        # avoid shipping data — here the device mesh is already local, and
+        # inferring per-block output shapes for every objective/kwarg
+        # combination is what map_blocks gets wrong)
         return self._local.predict(_concat_to_local(X), **kwargs)
 
     def predict_proba(self, X, **kwargs):
-        import dask.array as da
-        if isinstance(X, da.Array):
-            model = self._local
-
-            def _part(block):
-                return model.predict_proba(block, **kwargs)
-
-            return X.map_blocks(_part)
         return self._local.predict_proba(_concat_to_local(X), **kwargs)
 
     def __getattr__(self, name):

@@ -50,15 +50,43 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if categorical_feature != "auto":
         train_set.categorical_feature = categorical_feature
 
-    booster = Booster(params=params, train_set=train_set)
+    base_model = None
     if init_model is not None:
-        Log.warning("init_model continued training is applied via "
-                    "init_score predictions")
-        base = init_model if isinstance(init_model, Booster) else \
+        # continued training (reference: input_model seeds init scores,
+        # application.cpp:91-94; the final model keeps the old trees,
+        # Python Booster(model_file=...) + train). Scores are seeded with
+        # the base model's raw predictions BEFORE dataset construction
+        # (raw features are still present), and the base trees are merged
+        # into the final model so predict/save include them.
+        base_model = init_model if isinstance(init_model, Booster) else \
             Booster(model_file=init_model)
-        # seed scores with the existing model's raw predictions
-        raise NotImplementedError(
-            "init_model continuation lands with the CLI refit task")
+
+        def _seed(ds):
+            if ds is None or ds.init_score is not None:
+                return
+            if ds.data is None:
+                raise ValueError(
+                    "init_model continuation needs raw data on the "
+                    "datasets; pass free_raw_data=False or un-constructed "
+                    "Datasets")
+            init = base_model.predict(ds.data, raw_score=True)
+            ds.init_score = init
+            if ds._binned is not None:
+                # dataset already constructed: construct() won't re-read
+                # init_score, so push it into the binned metadata directly
+                ds._binned.metadata.init_score = \
+                    np.asarray(init, np.float32)
+
+        _seed(train_set)
+        if valid_sets is not None:
+            vs = valid_sets if isinstance(valid_sets, list) else [valid_sets]
+            for vd in vs:
+                if isinstance(vd, Dataset) and vd is not train_set:
+                    _seed(vd)
+
+    booster = Booster(params=params, train_set=train_set)
+    if base_model is not None:
+        booster._base_model = base_model
 
     is_valid_contain_train = False
     train_data_name = "training"
@@ -118,7 +146,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     begin_iteration=0, end_iteration=num_boost_round,
                     evaluation_result_list=evaluation_result_list))
     except callback_mod.EarlyStopException as es:
-        booster.best_iteration = es.best_iteration + 1
+        # with continued training, iteration indexing covers the merged
+        # model (base trees first), matching predict(num_iteration=...)
+        base_iters = base_model.current_iteration() \
+            if base_model is not None else 0
+        booster.best_iteration = base_iters + es.best_iteration + 1
         evaluation_result_list = es.best_score
     if booster.best_iteration < 0:
         booster.best_iteration = booster.current_iteration()

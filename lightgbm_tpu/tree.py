@@ -562,6 +562,7 @@ class HostModel:
         from .data import Metadata
         import jax.numpy as jnp
         new_model = copy.deepcopy(self)
+        new_model._native_flat = None  # leaf values change in place below
         obj = create_objective(self.objective.split(" ")[0], config)
         md = Metadata(len(label), label=label)
         obj.init(md, len(label))
