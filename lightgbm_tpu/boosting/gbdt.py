@@ -322,7 +322,7 @@ class GBDT:
                 hp=self.hp, bmax=self.bmax, monotone=self._monotone,
                 interaction_groups=self._interaction_groups,
                 feature_fraction_bynode=cfg.feature_fraction_bynode,
-                rng_key=rng_key)
+                rng_key=rng_key, hist_double_prec=cfg.gpu_use_dp)
         if self._grower is None:
             out = grow_tree(
                 self.bins, g, h, cnt, feature_mask, self.num_bins_d,

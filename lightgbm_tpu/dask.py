@@ -24,6 +24,8 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+import numpy as np
+
 from .sklearn import LGBMClassifier, LGBMRanker, LGBMRegressor
 
 __all__ = ["DaskLGBMClassifier", "DaskLGBMRegressor", "DaskLGBMRanker"]
@@ -81,16 +83,23 @@ class _DaskBase:
         self._local.fit(Xl, yl, sample_weight=sw, **fit_kwargs)
         return self
 
-    def predict(self, X, **kwargs):
+    def _predict_impl(self, X, method, **kwargs):
         # partitions are scored on the driver against the local model (the
         # reference's per-worker _predict_part, dask.py:811, exists to
-        # avoid shipping data — here the device mesh is already local, and
-        # inferring per-block output shapes for every objective/kwarg
-        # combination is what map_blocks gets wrong)
-        return self._local.predict(_concat_to_local(X), **kwargs)
+        # avoid shipping data — here the device mesh is already local).
+        # Dask collections stay dask collections so .compute() keeps
+        # working for callers written against the reference contract.
+        import dask.array as da
+        import dask.dataframe as dd
+        is_dask = isinstance(X, (da.Array, dd.DataFrame, dd.Series))
+        out = np.asarray(method(_concat_to_local(X), **kwargs))
+        return da.from_array(out, chunks=out.shape) if is_dask else out
+
+    def predict(self, X, **kwargs):
+        return self._predict_impl(X, self._local.predict, **kwargs)
 
     def predict_proba(self, X, **kwargs):
-        return self._local.predict_proba(_concat_to_local(X), **kwargs)
+        return self._predict_impl(X, self._local.predict_proba, **kwargs)
 
     def __getattr__(self, name):
         # delegate attributes (booster_, feature_importances_, ...) to the

@@ -62,8 +62,18 @@ def train(params: Dict[str, Any], train_set: Dataset,
             Booster(model_file=init_model)
 
         def _seed(ds):
-            if ds is None or ds.init_score is not None:
+            if ds is None:
                 return
+            existing = ds.init_score
+            if existing is None and ds._binned is not None:
+                existing = ds._binned.metadata.init_score
+            if existing is not None:
+                # base trees are prepended to the final model, so an extra
+                # user init_score would double-count — refuse rather than
+                # silently produce shifted predictions
+                raise ValueError(
+                    "cannot combine init_model with a dataset that "
+                    "already has init_score")
             if ds.data is None:
                 raise ValueError(
                     "init_model continuation needs raw data on the "
@@ -251,6 +261,11 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
        return_cvbooster: bool = False) -> Dict[str, List[float]]:
     params = copy.deepcopy(params or {})
     num_boost_round = _resolve_num_boost_round(params, num_boost_round)
+    if init_model is not None:
+        # fail loudly rather than silently ignoring the base model
+        raise NotImplementedError(
+            "cv() does not support init_model continuation yet; "
+            "use train(init_model=...) per fold")
     if fobj is not None:
         params["objective"] = "none"
     if metrics:

@@ -42,7 +42,7 @@ __all__ = ["grow_tree_mxu"]
     jax.jit,
     static_argnames=("num_leaves", "max_depth", "hp", "bmax",
                      "interaction_groups", "feature_fraction_bynode",
-                     "interpret"))
+                     "interpret", "hist_double_prec"))
 def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   cnt_weight: jax.Array, feature_mask: jax.Array,
                   num_bins: jax.Array, missing_is_nan: jax.Array,
@@ -52,7 +52,8 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   interaction_groups: Optional[tuple] = None,
                   feature_fraction_bynode: float = 1.0,
                   rng_key: Optional[jax.Array] = None,
-                  interpret: bool = False
+                  interpret: bool = False,
+                  hist_double_prec: bool = True
                   ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree; same contract as grower.grow_tree (serial mode)."""
     n, f = bins.shape
@@ -117,7 +118,8 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
         hist = build_histograms_mxu(
             bins, grad, hess, cnt_weight, row_slot, num_slots=s, bmax=bmax,
-            interpret=interpret, **hist_cfg(s))
+            interpret=interpret, double_prec=hist_double_prec,
+            **hist_cfg(s))
 
         slot_fmask = jnp.broadcast_to(feature_mask[None, :], (s, f))
         if use_bynode:

@@ -56,7 +56,7 @@ def _round_up(x: int, k: int) -> int:
 # ---------------------------------------------------------------------------
 
 def _hist_kernel(nb: int, fc: int, b: int, s: int, flane: int,
-                 mm_dtype=jnp.bfloat16):
+                 mm_dtype=jnp.bfloat16, nchan: int = 5):
     fcb = fc * b
 
     def kernel(block_any_ref, slot_ref, bins_ref, data_ref, out_ref):
@@ -91,7 +91,7 @@ def _hist_kernel(nb: int, fc: int, b: int, s: int, flane: int,
                 .astype(mm_dtype)                            # [nb, fc*B]
 
             data = data_ref[:]                               # [nb, 8] f32
-            for c in range(5):  # g_hi, g_lo, h_hi, h_lo, cnt
+            for c in range(nchan):  # hi/lo pairs + cnt, or g/h/cnt
                 lhs = jnp.where(slot_oh, data[:, c:c + 1],
                                 jnp.float32(0.0)).astype(mm_dtype)
                 part = jax.lax.dot_general(
@@ -105,16 +105,23 @@ def _hist_kernel(nb: int, fc: int, b: int, s: int, flane: int,
 
 @functools.partial(
     jax.jit, static_argnames=("num_slots", "bmax", "row_block", "fchunk",
-                              "interpret", "use_f32"))
+                              "interpret", "use_f32", "double_prec"))
 def build_histograms_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                          cnt: jax.Array, row_slot: jax.Array, *,
                          num_slots: int, bmax: int, row_block: int = 1024,
                          fchunk: int = 4, use_f32: bool = False,
+                         double_prec: bool = True,
                          interpret: bool = False) -> jax.Array:
     """Per-slot histograms without sorting or gathering.
 
     Args mirror build_histograms; row_slot < 0 routes to no slot.
     Returns [num_slots, F, bmax, 3] f32 (grad, hess, count).
+
+    double_prec=True splits gradients AND hessians into hi/lo bf16 pairs
+    (~f32-accurate sums, 5 matmul channels). False keeps gradient sums
+    hi/lo-exact but sums hessians as single bf16 (~2^-9 relative error;
+    4 channels, ~1.3x faster) — the TPU analog of the reference GPU
+    backend's gpu_use_dp switch.
     """
     n, f = bins.shape
     nb = row_block
@@ -143,11 +150,17 @@ def build_histograms_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # reduce_precision (not a bf16 round-trip, which XLA elides under
     # --xla_allow_excess_precision) keeps the hi/lo split honest
     g_hi = jax.lax.reduce_precision(g, exponent_bits=8, mantissa_bits=7)
-    h_hi = jax.lax.reduce_precision(h, exponent_bits=8, mantissa_bits=7)
-    data = jnp.stack([g_hi, g - g_hi, h_hi, h - h_hi,
-                      cnt.astype(jnp.float32),
-                      jnp.zeros_like(g), jnp.zeros_like(g),
-                      jnp.zeros_like(g)], axis=1)            # [N, 8]
+    if double_prec:
+        h_hi = jax.lax.reduce_precision(h, exponent_bits=8, mantissa_bits=7)
+        chans = [g_hi, g - g_hi, h_hi, h - h_hi, cnt.astype(jnp.float32)]
+    else:
+        # mixed precision: gradient sums (the squared gain numerator) stay
+        # hi/lo-exact, hessian sums ride single bf16 — the denominator is
+        # smoothed by lambda_l2/min_hessian and tolerates ~2^-9 error
+        chans = [g_hi, g - g_hi, h, cnt.astype(jnp.float32)]
+    nchan = len(chans)
+    data = jnp.stack(chans + [jnp.zeros_like(g)] * (8 - nchan),
+                     axis=1)                                 # [N, 8]
     if npad:
         data = jnp.pad(data, ((0, npad), (0, 0)))
 
@@ -162,24 +175,29 @@ def build_histograms_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             pl.BlockSpec((nb, flane), lambda ci, ri, ba: (ri, 0)),
             pl.BlockSpec((nb, 8), lambda ci, ri, ba: (ri, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 5 * s, fc * b),
+        out_specs=pl.BlockSpec((1, nchan * s, fc * b),
                                lambda ci, ri, ba: (ci, 0, 0)))
     out = pl.pallas_call(
         _hist_kernel(nb, fc, b, s, flane,
-                     jnp.float32 if use_f32 else jnp.bfloat16),
+                     jnp.float32 if use_f32 else jnp.bfloat16,
+                     nchan=nchan),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((nchunks, 5 * s, fc * b),
+        out_shape=jax.ShapeDtypeStruct((nchunks, nchan * s, fc * b),
                                        jnp.float32),
         interpret=interpret,
         **({} if interpret else {"compiler_params": _COMPILER_PARAMS}),
     )(block_any, slot[:, None], bins, data)
 
-    # [nchunks, 5S, fc*B] -> [S, F, B, 3]
-    out = out.reshape(nchunks, 5, s, fc, b)
-    out = jnp.transpose(out, (2, 1, 0, 3, 4)).reshape(s, 5, fpad, b)
+    # [nchunks, C*S, fc*B] -> [S, F, B, 3]
+    out = out.reshape(nchunks, nchan, s, fc, b)
+    out = jnp.transpose(out, (2, 1, 0, 3, 4)).reshape(s, nchan, fpad, b)
     out = out[:, :, :f, :bmax]
-    hist = jnp.stack([out[:, 0] + out[:, 1], out[:, 2] + out[:, 3],
-                      out[:, 4]], axis=-1)                   # [S, F, B, 3]
+    if double_prec:
+        hist = jnp.stack([out[:, 0] + out[:, 1], out[:, 2] + out[:, 3],
+                          out[:, 4]], axis=-1)               # [S, F, B, 3]
+    else:
+        hist = jnp.stack([out[:, 0] + out[:, 1], out[:, 2], out[:, 3]],
+                         axis=-1)
     return hist
 
 

@@ -177,7 +177,8 @@ class LGBMModel:
         self._Booster = train_fn(
             params, train_set, num_boost_round=self.n_estimators,
             valid_sets=valid_sets or None,
-            valid_names=valid_names or None, callbacks=cbs)
+            valid_names=valid_names or None, callbacks=cbs,
+            init_model=init_model)
         self._n_features = np.asarray(X).shape[1] \
             if hasattr(X, "shape") else train_set.num_feature()
         self._best_iteration = self._Booster.best_iteration

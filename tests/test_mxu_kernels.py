@@ -92,6 +92,29 @@ class TestMXUGrower:
         np.testing.assert_allclose(np.asarray(hm), np.asarray(hr)[:8],
                                    rtol=1e-4, atol=1e-4)
 
+    def test_histogram_single_precision_close(self):
+        # gpu_use_dp=false mode: grad sums stay hi/lo-exact, hessian sums
+        # ride single bf16 (~2^-9 relative)
+        ds, g, h = _data(n=3000)
+        bins = jnp.asarray(ds.bins)
+        cnt = jnp.ones(ds.num_data, jnp.float32)
+        slot = jnp.asarray(
+            np.random.RandomState(1).randint(0, 8, size=ds.num_data)
+            .astype(np.int32))
+        bmax = int(ds.num_bins.max())
+        hm = build_histograms_mxu(bins, g, h, cnt, slot, num_slots=8,
+                                  bmax=bmax, double_prec=False,
+                                  interpret=True)
+        hr = build_histograms(bins, g, h, slot, cnt, num_slots=8, bmax=bmax)
+        np.testing.assert_allclose(np.asarray(hm[..., 0]),
+                                   np.asarray(hr)[:8, ..., 0],
+                                   rtol=1e-4, atol=1e-4)  # grads hi/lo
+        np.testing.assert_allclose(np.asarray(hm[..., 1]),
+                                   np.asarray(hr)[:8, ..., 1],
+                                   rtol=2e-2, atol=1e-2)  # hess bf16
+        np.testing.assert_array_equal(np.asarray(hm[..., 2]),
+                                      np.asarray(hr)[:8, ..., 2])
+
     def test_node_values_lookup(self):
         rng = np.random.RandomState(0)
         node = jnp.asarray(rng.randint(0, 61, size=5000).astype(np.int32))
