@@ -47,7 +47,6 @@ def _concat_to_local(part):
     (parallel/learner.py 'data' mode)."""
     import dask.array as da
     import dask.dataframe as dd
-    import numpy as np
     if isinstance(part, da.Array):
         return part.compute()
     if isinstance(part, (dd.DataFrame, dd.Series)):

@@ -67,10 +67,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
             existing = ds.init_score
             if existing is None and ds._binned is not None:
                 existing = ds._binned.metadata.init_score
-            if existing is not None:
+            if existing is not None and \
+                    not getattr(ds, "_seeded_init_score", False):
                 # base trees are prepended to the final model, so an extra
-                # user init_score would double-count — refuse rather than
-                # silently produce shifted predictions
+                # USER init_score would double-count — refuse rather than
+                # silently produce shifted predictions. Scores that _seed
+                # itself wrote on a previous train() are overwritten below
+                # (iterative continuation reuses the same Dataset).
                 raise ValueError(
                     "cannot combine init_model with a dataset that "
                     "already has init_score")
@@ -81,6 +84,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     "Datasets")
             init = base_model.predict(ds.data, raw_score=True)
             ds.init_score = init
+            ds._seeded_init_score = True
             if ds._binned is not None:
                 # dataset already constructed: construct() won't re-read
                 # init_score, so push it into the binned metadata directly
@@ -93,6 +97,22 @@ def train(params: Dict[str, Any], train_set: Dataset,
             for vd in vs:
                 if isinstance(vd, Dataset) and vd is not train_set:
                     _seed(vd)
+    else:
+        # a plain train() after a continued one must not inherit the seed
+        # the previous call wrote into this Dataset
+        def _unseed(ds):
+            if ds is not None and getattr(ds, "_seeded_init_score", False):
+                ds.init_score = None
+                ds._seeded_init_score = False
+                if ds._binned is not None:
+                    ds._binned.metadata.init_score = None
+
+        _unseed(train_set)
+        if valid_sets is not None:
+            vs = valid_sets if isinstance(valid_sets, list) else [valid_sets]
+            for vd in vs:
+                if isinstance(vd, Dataset):
+                    _unseed(vd)
 
     booster = Booster(params=params, train_set=train_set)
     if base_model is not None:

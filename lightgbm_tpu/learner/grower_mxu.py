@@ -267,6 +267,14 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
              path_mask0,
              jnp.asarray(False))
 
+    def cond_pass(s, st, pass_idx, k_cap=None):
+        # skip whole passes once growth is done — e.g. the full-capacity
+        # bridge pass after a tree that completed on schedule (a free
+        # S=s_max histogram otherwise)
+        return jax.lax.cond(
+            st[8], lambda st_: st_,
+            lambda st_: one_pass(s, st_, pass_idx, k_cap), st)
+
     # ---- unrolled doubling schedule ----
     schedule = []
     s_p = 1
@@ -274,9 +282,7 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         schedule.append(min(max(2 * s_p, 2), s_max))
         s_p *= 2
     for p, s_p in enumerate(schedule):
-        # lax.cond would force both branches; a masked pass is harmless
-        # (done => no eligible splits, k becomes 0), so run unconditionally
-        state = one_pass(s_p, state, jnp.asarray(p, jnp.int32))
+        state = cond_pass(s_p, state, jnp.asarray(p, jnp.int32))
 
     # ---- fixup loop for off-schedule leftovers ----
     # the best-first tail often splits only a couple of leaves per pass
@@ -288,7 +294,7 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     s_fix = min(64, s_max)
     k_fix = max(1, s_fix // 2)
     if schedule:
-        state = one_pass(s_max, state, len(schedule), k_cap=k_fix)
+        state = cond_pass(s_max, state, len(schedule), k_cap=k_fix)
 
     def cond(c):
         st, it = c

@@ -26,7 +26,8 @@ every per-row memory op from the growth pass:
   every table value stays exactly representable in f32.
 
 HBM traffic per pass: one read of the binned matrix + small blocks;
-flops: 5 * S * N * F * B MACs (bf16) for the histogram, negligible for
+flops: nchan * S * N * F * B MACs (bf16; nchan = 5 with double-precision
+sums, 4 with single-bf16 hessians) for the histogram, negligible for
 routing.
 """
 

@@ -51,6 +51,31 @@ class TestContinuation:
         np.testing.assert_allclose(b2.predict(X), b3.predict(X), rtol=1e-6)
         assert b3.num_trees() == 10
 
+    def test_iterative_continuation_same_dataset(self):
+        # b1 -> b2 -> b3 chained on ONE Dataset object; then a plain
+        # train() on it must not inherit the stale seeded scores
+        X, y = _data(4)
+        ds = lgb.Dataset(X, label=y, free_raw_data=False)
+        b1 = lgb.train(PARAMS, ds, 5)
+        b2 = lgb.train(PARAMS, ds, 5, init_model=b1)
+        b3 = lgb.train(PARAMS, ds, 5, init_model=b2)
+        assert b3.num_trees() == 15
+        m1 = np.mean((b1.predict(X) - y) ** 2)
+        m3 = np.mean((b3.predict(X) - y) ** 2)
+        assert m3 < m1
+        b_plain = lgb.train(PARAMS, ds, 5)
+        np.testing.assert_allclose(b_plain.predict(X), b1.predict(X),
+                                   rtol=1e-5)
+
+    def test_user_init_score_conflict_raises(self):
+        X, y = _data(5)
+        b1 = lgb.train(PARAMS, lgb.Dataset(X, label=y,
+                                           free_raw_data=False), 5)
+        ds = lgb.Dataset(X, label=y, free_raw_data=False,
+                         init_score=np.zeros(len(y)))
+        with pytest.raises(ValueError):
+            lgb.train(PARAMS, ds, 5, init_model=b1)
+
     def test_cli_input_model(self, tmp_path):
         from lightgbm_tpu.cli import main
         X, y = _data(2)
