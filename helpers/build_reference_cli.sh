@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Build the reference LightGBM CLI out-of-tree for differential testing
+# (tests/test_reference_consistency.py). The reference checkout has empty
+# vendored submodules (no network), so three tiny stand-ins cover the only
+# surfaces its core uses: fast_double_parser::parse_number (-> strtod),
+# fmt::format_to_n with "{}"/"{:g}"/"{:.17g}" (-> snprintf), and the
+# MatrixXd/fullPivLu().inverse() slice of Eigen used by linear trees
+# (-> Gauss-Jordan). Its CMake links into the read-only source dir, so the
+# final link is done by hand.
+#
+# Usage: bash helpers/build_reference_cli.sh [REFERENCE_DIR] [BUILD_DIR]
+set -euo pipefail
+REF=${1:-/root/reference}
+BUILD=${2:-/tmp/lgbbuild}
+SHIM=$(dirname "$BUILD")/lgbshim
+
+mkdir -p "$SHIM/external_libs/fast_double_parser/include" \
+         "$SHIM/external_libs/fmt/include/fmt" \
+         "$SHIM/eigen/Eigen" "$SHIM/anchor/a/b"
+ln -sfn "$SHIM/external_libs" "$SHIM/anchor/external_libs"
+
+cp "$(dirname "$0")/reference_shims/fast_double_parser.h" \
+   "$SHIM/external_libs/fast_double_parser/include/"
+cp "$(dirname "$0")/reference_shims/fmt_format.h" \
+   "$SHIM/external_libs/fmt/include/fmt/format.h"
+cp "$(dirname "$0")/reference_shims/eigen_dense.h" "$SHIM/eigen/Eigen/Dense"
+
+cmake -S "$REF" -B "$BUILD" -DCMAKE_BUILD_TYPE=Release -DUSE_OPENMP=ON \
+  -DCMAKE_CXX_FLAGS="-I$SHIM/anchor/a/b -I$SHIM/eigen"
+# compile strictly (any failure aborts); only the link into the read-only
+# source tree is bypassed, by building the object library and main.cpp
+# and linking by hand
+cmake --build "$BUILD" -j8 --target lightgbm_objs
+for src in main application/application; do
+  g++ -std=c++17 -O3 -fopenmp -I"$REF/include" \
+    -I"$SHIM/anchor/a/b" -I"$SHIM/eigen" \
+    -c "$REF/src/$src.cpp" -o "$BUILD/$(basename "$src").o"
+done
+g++ -fopenmp -O3 -o "$BUILD/lightgbm" "$BUILD/main.o" \
+  "$BUILD/application.o" \
+  $(find "$BUILD/CMakeFiles/lightgbm_objs.dir" -name '*.o') -lpthread
+echo "built $BUILD/lightgbm"

@@ -1,0 +1,85 @@
+// Mini stand-in for Eigen (vendored submodule absent): exactly the surface
+// linear_tree_learner.cpp touches — dynamic double matrices, (i,j)/(i)
+// access, product, unary minus, fullPivLu().inverse() via Gauss-Jordan
+// with partial pivoting (singular matrices yield inf/nan like Eigen).
+#pragma once
+#include <cmath>
+#include <cstddef>
+#include <utility>
+#include <vector>
+namespace Eigen {
+class MatrixXd;
+struct FullPivLU_shim {
+  const MatrixXd* m;
+  inline MatrixXd inverse() const;
+};
+class MatrixXd {
+ public:
+  MatrixXd() : r_(0), c_(0) {}
+  MatrixXd(std::ptrdiff_t r, std::ptrdiff_t c)
+      : r_(r), c_(c), d_(r * c, 0.0) {}
+  double& operator()(std::ptrdiff_t i, std::ptrdiff_t j) {
+    return d_[i * c_ + j];
+  }
+  double operator()(std::ptrdiff_t i, std::ptrdiff_t j) const {
+    return d_[i * c_ + j];
+  }
+  double& operator()(std::ptrdiff_t i) { return d_[i]; }
+  double operator()(std::ptrdiff_t i) const { return d_[i]; }
+  std::ptrdiff_t rows() const { return r_; }
+  std::ptrdiff_t cols() const { return c_; }
+
+  MatrixXd operator*(const MatrixXd& o) const {
+    MatrixXd out(r_, o.c_);
+    for (std::ptrdiff_t i = 0; i < r_; ++i)
+      for (std::ptrdiff_t k = 0; k < c_; ++k) {
+        const double v = (*this)(i, k);
+        for (std::ptrdiff_t j = 0; j < o.c_; ++j)
+          out(i, j) += v * o(k, j);
+      }
+    return out;
+  }
+  MatrixXd operator-() const {
+    MatrixXd out(r_, c_);
+    for (size_t i = 0; i < d_.size(); ++i) out.d_[i] = -d_[i];
+    return out;
+  }
+  FullPivLU_shim fullPivLu() const { return FullPivLU_shim{this}; }
+
+ private:
+  std::ptrdiff_t r_, c_;
+  std::vector<double> d_;
+};
+
+inline MatrixXd FullPivLU_shim::inverse() const {
+  const std::ptrdiff_t n = m->rows();
+  MatrixXd a = *m;
+  MatrixXd inv(n, n);
+  for (std::ptrdiff_t i = 0; i < n; ++i) inv(i, i) = 1.0;
+  for (std::ptrdiff_t col = 0; col < n; ++col) {
+    std::ptrdiff_t piv = col;
+    for (std::ptrdiff_t i = col + 1; i < n; ++i)
+      if (std::fabs(a(i, col)) > std::fabs(a(piv, col))) piv = i;
+    if (piv != col)
+      for (std::ptrdiff_t j = 0; j < n; ++j) {
+        std::swap(a(col, j), a(piv, j));
+        std::swap(inv(col, j), inv(piv, j));
+      }
+    const double p = a(col, col);
+    for (std::ptrdiff_t j = 0; j < n; ++j) {
+      a(col, j) /= p;
+      inv(col, j) /= p;
+    }
+    for (std::ptrdiff_t i = 0; i < n; ++i) {
+      if (i == col) continue;
+      const double f = a(i, col);
+      if (f == 0.0) continue;
+      for (std::ptrdiff_t j = 0; j < n; ++j) {
+        a(i, j) -= f * a(col, j);
+        inv(i, j) -= f * inv(col, j);
+      }
+    }
+  }
+  return inv;
+}
+}  // namespace Eigen

@@ -1,0 +1,14 @@
+// stand-in for the vendored fast_double_parser (submodule not checked out;
+// no network in this environment). strtod has the same accept-grammar for
+// the inputs LightGBM feeds it and runs under the C locale here.
+#pragma once
+#include <cstdlib>
+namespace fast_double_parser {
+inline const char* parse_number(const char* p, double* out) {
+  char* end = nullptr;
+  double v = std::strtod(p, &end);
+  if (end == p) return nullptr;
+  *out = v;
+  return end;
+}
+}  // namespace fast_double_parser

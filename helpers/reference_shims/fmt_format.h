@@ -1,0 +1,26 @@
+// stand-in for vendored {fmt}: LightGBM only calls
+// fmt::format_to_n(buf, n, fmt, value) with "{}", "{:g}", "{:.17g}".
+#pragma once
+#include <cstdio>
+#include <cstring>
+#include <type_traits>
+namespace fmt {
+struct format_to_n_result_shim { char* out; size_t size; };
+template <typename T>
+inline format_to_n_result_shim format_to_n(char* buf, size_t n,
+                                           const char* format, T value) {
+  int written;
+  if constexpr (std::is_floating_point<T>::value) {
+    const char* pf = "%.17g";
+    if (std::strcmp(format, "{:g}") == 0) pf = "%g";
+    written = std::snprintf(buf, n, pf, static_cast<double>(value));
+  } else if constexpr (std::is_signed<T>::value) {
+    written = std::snprintf(buf, n, "%lld",
+                            static_cast<long long>(value));
+  } else {
+    written = std::snprintf(buf, n, "%llu",
+                            static_cast<unsigned long long>(value));
+  }
+  return {buf + (written < (int)n ? written : (int)n), (size_t)written};
+}
+}  // namespace fmt

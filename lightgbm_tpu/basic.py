@@ -231,6 +231,12 @@ class Dataset:
 
     def set_init_score(self, init_score):
         self.init_score = init_score
+        # a user-provided score replaces any continuation seed, so the
+        # init_model double-count guard must see it as user-owned again
+        self._seeded_init_score = False
+        if self._binned is not None:
+            self._binned.metadata.init_score = None if init_score is None \
+                else np.asarray(init_score, np.float32)
         return self
 
     def set_field(self, name, data):
@@ -254,7 +260,18 @@ class Dataset:
         """Write the constructed dataset to a binary cache file
         (reference basic.py Dataset.save_binary / LGBM_DatasetSaveBinary)."""
         self.construct()
-        self._binned.save_binary(filename)
+        if getattr(self, "_seeded_init_score", False):
+            # continuation seeds are transient training state; persisting
+            # them would silently shift any model later trained from the
+            # cache (the loaded Dataset cannot know they were seeded)
+            saved = self._binned.metadata.init_score
+            self._binned.metadata.init_score = None
+            try:
+                self._binned.save_binary(filename)
+            finally:
+                self._binned.metadata.init_score = saved
+        else:
+            self._binned.save_binary(filename)
         return self
 
     @property

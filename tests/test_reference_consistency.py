@@ -1,0 +1,152 @@
+"""Differential testing against the reference LightGBM binary.
+
+SURVEY.md §4 test_consistency equivalent, but stronger: the model text
+format (tree.py) claims byte-level compatibility with the reference
+(gbdt_model_text.cpp), so models must cross-load in BOTH directions:
+
+- ours -> reference: a model trained here is scored by the reference CLI
+  and must reproduce our predictions;
+- reference -> ours: a model trained by the reference CLI is loaded by
+  our Booster and must reproduce the reference's predictions.
+
+Requires the reference CLI binary (build out-of-tree:
+`cmake -S /root/reference -B /tmp/lgbbuild && cmake --build /tmp/lgbbuild
+--target lightgbm`); tests skip when it is absent. Set LGBM_REFERENCE_BIN
+to point at the binary explicitly.
+"""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+_BIN = os.environ.get("LGBM_REFERENCE_BIN", "/tmp/lgbbuild/lightgbm")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(_BIN), reason="reference binary not built")
+
+
+def _run_ref(conf_path):
+    res = subprocess.run([_BIN, f"config={conf_path}"],
+                         capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def _write_csv(path, X, y=None):
+    arr = X if y is None else np.column_stack([y, X])
+    np.savetxt(path, arr, delimiter=",", fmt="%.8g")
+
+
+def _data(seed=0, n=3000, f=6, with_nan=False, with_cat=False):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    if with_cat:
+        X[:, 1] = rng.randint(0, 12, n)
+    if with_nan:
+        X[rng.rand(n) < 0.05, 2] = np.nan
+    y = ((np.nan_to_num(X[:, 0]) + 0.4 * np.nan_to_num(X[:, 2]) +
+          (X[:, 1].astype(int) % 3 == 0 if with_cat else 0)) >
+         0.3).astype(np.float64)
+    return X, y
+
+
+class TestOursToReference:
+    def _check(self, tmp_path, with_nan=False, with_cat=False, **params):
+        X, y = _data(1, with_nan=with_nan, with_cat=with_cat)
+        cat = [1] if with_cat else None
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbosity": -1, "min_data_in_leaf": 5, **params},
+                        lgb.Dataset(X, label=y, categorical_feature=cat),
+                        10)
+        model = tmp_path / "ours.txt"
+        bst.save_model(str(model))
+        # the reference CLI defaults label_column=0, so prediction files
+        # carry the label in column 0 like training files
+        _write_csv(tmp_path / "test.csv", X, y)
+        conf = tmp_path / "predict.conf"
+        conf.write_text(
+            f"task=predict\ndata={tmp_path}/test.csv\n"
+            f"input_model={model}\noutput_result={tmp_path}/ref_preds.txt\n"
+            "header=false\nlabel_column=0\n")
+        _run_ref(conf)
+        ref = np.loadtxt(tmp_path / "ref_preds.txt")
+        ours = bst.predict(X)
+        np.testing.assert_allclose(ref, ours, rtol=1e-5, atol=1e-6)
+
+    def test_numerical(self, tmp_path):
+        self._check(tmp_path)
+
+    def test_nan_routing(self, tmp_path):
+        self._check(tmp_path, with_nan=True)
+
+    def test_categorical_bitsets(self, tmp_path):
+        self._check(tmp_path, with_cat=True)
+
+    def test_linear_trees(self, tmp_path):
+        # linear-leaf serialization (is_linear/leaf_const/leaf_coeff)
+        # scored by the reference's linear prediction path
+        X, y = _data(3)
+        yr = (X[:, 0] + 0.5 * X[:, 2]).astype(np.float64)
+        bst = lgb.train({"objective": "regression", "num_leaves": 8,
+                         "linear_tree": True, "verbosity": -1},
+                        lgb.Dataset(X, label=yr), 8)
+        model = tmp_path / "lin.txt"
+        bst.save_model(str(model))
+        _write_csv(tmp_path / "test.csv", X, yr)
+        conf = tmp_path / "predict.conf"
+        conf.write_text(
+            f"task=predict\ndata={tmp_path}/test.csv\n"
+            f"input_model={model}\noutput_result={tmp_path}/p.txt\n"
+            "header=false\nlabel_column=0\n")
+        _run_ref(conf)
+        np.testing.assert_allclose(np.loadtxt(tmp_path / "p.txt"),
+                                   bst.predict(X), rtol=1e-5, atol=1e-6)
+
+
+class TestReferenceToOurs:
+    def test_cross_load(self, tmp_path):
+        X, y = _data(2, with_nan=True)
+        _write_csv(tmp_path / "train.csv", X, y)
+        train_conf = tmp_path / "train.conf"
+        train_conf.write_text(
+            f"task=train\nobjective=binary\ndata={tmp_path}/train.csv\n"
+            f"output_model={tmp_path}/ref_model.txt\nnum_trees=10\n"
+            "num_leaves=15\nmin_data_in_leaf=5\nheader=false\n"
+            "label_column=0\nverbosity=-1\n")
+        _run_ref(train_conf)
+        pred_conf = tmp_path / "pred.conf"
+        pred_conf.write_text(
+            f"task=predict\ndata={tmp_path}/train.csv\n"
+            f"input_model={tmp_path}/ref_model.txt\n"
+            f"output_result={tmp_path}/ref_preds.txt\nheader=false\n"
+            "label_column=0\n")
+        _run_ref(pred_conf)
+        ref_preds = np.loadtxt(tmp_path / "ref_preds.txt")
+        ours = lgb.Booster(model_file=str(tmp_path / "ref_model.txt"))
+        np.testing.assert_allclose(ours.predict(X), ref_preds,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_cross_load_categorical(self, tmp_path):
+        X, y = _data(4, with_cat=True)
+        _write_csv(tmp_path / "train.csv", X, y)
+        train_conf = tmp_path / "train.conf"
+        train_conf.write_text(
+            f"task=train\nobjective=binary\ndata={tmp_path}/train.csv\n"
+            f"output_model={tmp_path}/ref_model.txt\nnum_trees=10\n"
+            "num_leaves=15\nmin_data_in_leaf=5\nheader=false\n"
+            "label_column=0\ncategorical_feature=1\nverbosity=-1\n")
+        _run_ref(train_conf)
+        pred_conf = tmp_path / "pred.conf"
+        pred_conf.write_text(
+            f"task=predict\ndata={tmp_path}/train.csv\n"
+            f"input_model={tmp_path}/ref_model.txt\n"
+            f"output_result={tmp_path}/ref_preds.txt\nheader=false\n"
+            "label_column=0\n")
+        _run_ref(pred_conf)
+        ref_preds = np.loadtxt(tmp_path / "ref_preds.txt")
+        ours = lgb.Booster(model_file=str(tmp_path / "ref_model.txt"))
+        np.testing.assert_allclose(ours.predict(X), ref_preds,
+                                   rtol=1e-5, atol=1e-6)
