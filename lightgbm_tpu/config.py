@@ -237,6 +237,18 @@ _PARAMS: List[ParamSpec] = [
             "(~1.3x faster, small AUC cost); unlike the reference GPU "
             "backend (f32 when false) bf16 is coarser, so the default "
             "here is true"),
+    _p("hist_subtraction", bool, True, (),
+       desc="sibling-histogram subtraction on the TPU grower (reference "
+            "serial_tree_learner.cpp:311-326): build only the smaller "
+            "child's histogram, derive the larger as parent minus smaller "
+            "(~half the kernel slots per pass). false rebuilds every "
+            "child's histogram from rows"),
+    _p("tail_split_cap", int, 8, (), lambda v: v >= 0,
+       "hybrid growth throttle for the batched TPU grower: once fewer "
+       "leaves remain than splittable candidates, commit at most this "
+       "many splits per pass before re-ranking (approaches the "
+       "reference's strict best-first order, serial_tree_learner.cpp:159, "
+       "as the cap shrinks). 0 = unthrottled batched growth"),
 ]
 
 _SPEC_BY_NAME: Dict[str, ParamSpec] = {p.name: p for p in _PARAMS}

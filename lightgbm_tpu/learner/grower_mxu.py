@@ -38,11 +38,31 @@ from .split import (BestSplits, SplitHyperParams, find_best_splits,
 __all__ = ["grow_tree_mxu"]
 
 
+def _kernel_cap(s: int) -> int:
+    """Histogram-kernel slot capacity for a pass scanning `s` slots with
+    sibling subtraction: the all-fresh bulk needs s/2 (one slot per smaller
+    child), plus slack for stale pairs (leaves split later than the pass
+    that scanned them need both children built, 2 slots)."""
+    return min(s, s // 2 + 8)
+
+
+def _select_rows(onehot: jax.Array, table: jax.Array) -> jax.Array:
+    """Exact row selection table[idx] as a one-hot matmul (gathers are
+    ~10M rows/s through this backend; the MXU is not). Precision.HIGHEST
+    forces the f32 bf16x6 decomposition, which is exact for 0/1 lhs."""
+    return jax.lax.dot_general(
+        onehot.astype(jnp.float32), table,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("num_leaves", "max_depth", "hp", "bmax",
                      "interaction_groups", "feature_fraction_bynode",
-                     "interpret", "hist_double_prec"))
+                     "interpret", "hist_double_prec", "tail_split_cap",
+                     "hist_subtraction"))
 def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   cnt_weight: jax.Array, feature_mask: jax.Array,
                   num_bins: jax.Array, missing_is_nan: jax.Array,
@@ -53,9 +73,29 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   feature_fraction_bynode: float = 1.0,
                   rng_key: Optional[jax.Array] = None,
                   interpret: bool = False,
-                  hist_double_prec: bool = True
+                  hist_double_prec: bool = True,
+                  tail_split_cap: int = 0,
+                  hist_subtraction: bool = True
                   ) -> Tuple[TreeArrays, jax.Array]:
-    """Grow one tree; same contract as grower.grow_tree (serial mode)."""
+    """Grow one tree; same contract as grower.grow_tree (serial mode).
+
+    tail_split_cap > 0 enables hybrid growth: while the leaf budget is
+    loose (remaining leaves >= splittable leaves) passes split every
+    eligible leaf — the regime where batched and strict best-first growth
+    agree — and once the budget binds, passes commit at most
+    tail_split_cap splits before re-ranking, approaching the reference's
+    strict leaf-wise order (serial_tree_learner.cpp:159-210) as the cap
+    shrinks. Retained gains make tail passes cheap: only the new
+    children's histograms are built.
+
+    hist_subtraction applies the reference's sibling-histogram trick
+    (serial_tree_learner.cpp:311-326): kernel slots are assigned only to
+    the SMALLER child of each fresh split; the larger sibling's histogram
+    is parent minus smaller, with the parent row pulled from the previous
+    pass's scan tensor by an exact one-hot matmul. Nodes split later than
+    the pass that scanned them (stale parents) get both children built
+    (2 slots), and split selection is throttled so the per-pass slot cost
+    fits the kernel capacity (~s/2 instead of s slots per pass)."""
     n, f = bins.shape
     m = 2 * num_leaves - 1
     m1 = m + 1
@@ -63,6 +103,7 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     s_max = num_leaves + 1
     k_top = num_leaves - 1
     w_cat = (bmax + 31) // 32
+    P_all = (s_max + 1) // 2 + 2   # pair-state capacity (subtraction)
 
     root_g = jnp.sum(grad)
     root_h = jnp.sum(hess)
@@ -110,16 +151,55 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # block fits comfortably in VMEM, narrower for big frontiers
         return dict(row_block=2048, fchunk=7 if s <= 64 else 4)
 
-    def one_pass(s, st, pass_idx, k_cap=None):
-        """One growth pass at frontier capacity `s` (python int)."""
+    def one_pass(s, st, pass_idx, k_cap=None, sk_next=None):
+        """One growth pass at scan capacity `s` (python int). sk_next is
+        the kernel-slot capacity of the NEXT pass (selection is throttled
+        so committed splits' children fit it)."""
         (tree, row_node, row_slot, slot_nodes, best, cons_min, cons_max,
-         path_mask, done) = st
+         path_mask, done, scan_hist, pair_parent, pair_sleft,
+         pair_kstart) = st
         sn = slot_nodes[:s]
+        if sk_next is None:
+            sk_next = _kernel_cap(min(2 * s, s_max)) if hist_subtraction \
+                else min(2 * s, s_max)
 
-        hist = build_histograms_mxu(
-            bins, grad, hess, cnt_weight, row_slot, num_slots=s, bmax=bmax,
-            interpret=interpret, double_prec=hist_double_prec,
-            **hist_cfg(s))
+        if hist_subtraction:
+            # build only the slots assigned by the previous pass (smaller
+            # siblings + both children of stale parents) ...
+            sk = _kernel_cap(s)
+            kern = build_histograms_mxu(
+                bins, grad, hess, cnt_weight, row_slot, num_slots=sk,
+                bmax=bmax, interpret=interpret,
+                double_prec=hist_double_prec, **hist_cfg(sk))
+            # ... and reconstruct the full scan tensor [s, F, B, 3]:
+            # larger sibling = parent - smaller (exact one-hot row pulls)
+            npairs = (s + 1) // 2
+            ks = pair_kstart[:npairs]
+            pp = pair_parent[:npairs]
+            sl = pair_sleft[:npairs]
+            stale = pp < 0
+            kern2 = kern.reshape(sk, -1)
+            iota_k = jnp.arange(sk, dtype=jnp.int32)[None, :]
+            small = _select_rows(ks[:, None] == iota_k, kern2)
+            ks2 = jnp.where(stale & (ks >= 0), ks + 1, -1)  # empty pairs: none
+            stale_other = _select_rows(ks2[:, None] == iota_k, kern2)
+            iota_p = jnp.arange(s_max, dtype=jnp.int32)[None, :]
+            parent_h = _select_rows(pp[:, None] == iota_p,
+                                    scan_hist.reshape(s_max, -1))
+            other = jnp.where(stale[:, None], stale_other,
+                              parent_h - small)
+            left = jnp.where(sl[:, None], small, other)
+            right = jnp.where(sl[:, None], other, small)
+            hist = jnp.stack([left, right], axis=1) \
+                .reshape(2 * npairs, f, bmax, 3)[:s]
+            scan_hist = jax.lax.dynamic_update_slice(
+                jnp.zeros((s_max, f, bmax, 3), jnp.float32), hist,
+                (0, 0, 0, 0))
+        else:
+            hist = build_histograms_mxu(
+                bins, grad, hess, cnt_weight, row_slot, num_slots=s,
+                bmax=bmax, interpret=interpret,
+                double_prec=hist_double_prec, **hist_cfg(s))
 
         slot_fmask = jnp.broadcast_to(feature_mask[None, :], (s, f))
         if use_bynode:
@@ -163,8 +243,28 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         if k_cap is None:
             k_cap = min(k_top, s)  # children fill the next pass (2*s)
         k_allowed = jnp.minimum(jnp.asarray(k_cap, jnp.int32), budget)
+        if tail_split_cap > 0:
+            # hybrid growth: once fewer leaves remain than candidates, the
+            # commit ORDER matters (a committed split's children would have
+            # outranked lower candidates under best-first growth) — throttle
+            # to tail_split_cap splits per pass and re-rank
+            # >= : even at n_elig == budget the commit order matters (a
+            # committed split's children can outrank remaining candidates)
+            n_elig = jnp.sum(gains[:m] > -jnp.inf)
+            k_allowed = jnp.where(
+                n_elig >= budget,
+                jnp.minimum(k_allowed, tail_split_cap), k_allowed)
         top_vals, top_idx = jax.lax.top_k(gains, k_top)
         take = (jnp.arange(k_top) < k_allowed) & jnp.isfinite(top_vals)
+        ssn = jnp.full(m1, -1, jnp.int32).at[sn].set(
+            jnp.arange(s, dtype=jnp.int32)).at[m].set(-1)
+        if hist_subtraction:
+            # throttle so the selected splits' children fit the next
+            # pass's kernel slots: fresh parents cost 1 (smaller child
+            # only), stale parents 2 (both children built)
+            cand_fresh = ssn[top_idx] >= 0
+            cumcost = jnp.cumsum(jnp.where(cand_fresh, 1, 2))
+            take &= cumcost <= sk_next
         split_mask = jnp.zeros(m1, bool).at[top_idx].set(take)
         split_mask = split_mask.at[m].set(False)
         k = jnp.sum(split_mask.astype(jnp.int32))
@@ -232,18 +332,38 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             path_mask = path_mask.at[child_l].set(child_pm) \
                 .at[child_r].set(child_pm)
 
-        # ---- frontier slots for the children
+        # ---- scan slots for the children (find_best_splits ordering)
         slot_l = jnp.where(split_mask, 2 * order, -1)
         slot_r = jnp.where(split_mask, 2 * order + 1, -1)
-        slot_of_node = jnp.full(m1, -1, jnp.int32) \
-            .at[child_l].set(jnp.where(split_mask, slot_l, -1)) \
-            .at[child_r].set(jnp.where(split_mask, slot_r, -1)) \
-            .at[m].set(-1)
         slot_nodes = jnp.full(s_max + 1, m, jnp.int32) \
             .at[jnp.where(split_mask, slot_l, s_max)].set(
                 jnp.where(split_mask, child_l, m)) \
             .at[jnp.where(split_mask, slot_r, s_max)].set(
                 jnp.where(split_mask, child_r, m))[:s_max]
+
+        # ---- kernel slots + pair bookkeeping for the next pass
+        if hist_subtraction:
+            fresh_node = ssn >= 0
+            small_left = best.left_count <= rc
+            cost_node = jnp.where(split_mask,
+                                  jnp.where(fresh_node, 1, 2), 0)
+            kstart = jnp.cumsum(cost_node) - cost_node
+            route_l = jnp.where(~fresh_node | small_left, kstart, -1)
+            route_r = jnp.where(~fresh_node, kstart + 1,
+                                jnp.where(small_left, -1, kstart))
+            pidx = jnp.where(split_mask, order, P_all)
+            pair_parent = jnp.full(P_all + 1, -1, jnp.int32) \
+                .at[pidx].set(jnp.where(fresh_node, ssn, -1))[:P_all]
+            pair_sleft = jnp.full(P_all + 1, True) \
+                .at[pidx].set(fresh_node & small_left | ~fresh_node)[:P_all]
+            pair_kstart = jnp.full(P_all + 1, -1, jnp.int32) \
+                .at[pidx].set(kstart)[:P_all]
+        else:
+            route_l, route_r = slot_l, slot_r
+        slot_of_node = jnp.full(m1, -1, jnp.int32) \
+            .at[child_l].set(jnp.where(split_mask, route_l, -1)) \
+            .at[child_r].set(jnp.where(split_mask, route_r, -1)) \
+            .at[m].set(-1)
 
         # ---- route rows through the new splits (Pallas kernel)
         tbl, member = pack_route_tables(
@@ -255,8 +375,11 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
         done = (k == 0) | (new_tree.num_leaves >= num_leaves)
         return (new_tree, row_node, row_slot, slot_nodes, new_best,
-                cons_min, cons_max, path_mask, done)
+                cons_min, cons_max, path_mask, done, scan_hist,
+                pair_parent, pair_sleft, pair_kstart)
 
+    # pair 0 of the first pass is the root, built as a "stale" pair so
+    # its histogram comes straight from kernel slot 0 (no parent exists)
     state = (tree0,
              jnp.zeros(n, jnp.int32),                     # row_node
              jnp.zeros(n, jnp.int32),                     # row_slot
@@ -265,15 +388,20 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
              jnp.full(m1, -jnp.inf, jnp.float32),
              jnp.full(m1, jnp.inf, jnp.float32),
              path_mask0,
-             jnp.asarray(False))
+             jnp.asarray(False),
+             jnp.zeros((s_max if hist_subtraction else 1, f, bmax, 3),
+                       jnp.float32),                       # scan_hist
+             jnp.full(P_all, -1, jnp.int32),               # pair_parent
+             jnp.full(P_all, True),                        # pair_sleft
+             jnp.full(P_all, -1, jnp.int32).at[0].set(0))  # pair_kstart
 
-    def cond_pass(s, st, pass_idx, k_cap=None):
+    def cond_pass(s, st, pass_idx, k_cap=None, sk_next=None):
         # skip whole passes once growth is done — e.g. the full-capacity
         # bridge pass after a tree that completed on schedule (a free
         # S=s_max histogram otherwise)
         return jax.lax.cond(
             st[8], lambda st_: st_,
-            lambda st_: one_pass(s, st_, pass_idx, k_cap), st)
+            lambda st_: one_pass(s, st_, pass_idx, k_cap, sk_next), st)
 
     # ---- unrolled doubling schedule ----
     schedule = []
@@ -291,10 +419,16 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # kernel makes them cheap. One bridging pass at full capacity first:
     # it scans ALL children of the last scheduled pass (slots up to s_max)
     # while capping its own splits so the children fit the fixup frontier.
-    s_fix = min(64, s_max)
+    # tail passes are per-pass-floor bound; with a hybrid-growth cap the
+    # frontier only ever holds 2*cap fresh children, so shrink the fixup
+    # scan capacity accordingly
+    s_fix = min(64, s_max) if tail_split_cap <= 0 \
+        else min(s_max, max(16, 2 * tail_split_cap))
     k_fix = max(1, s_fix // 2)
+    sk_fix = _kernel_cap(s_fix) if hist_subtraction else None
     if schedule:
-        state = cond_pass(s_max, state, len(schedule), k_cap=k_fix)
+        state = cond_pass(s_max, state, len(schedule), k_cap=k_fix,
+                          sk_next=sk_fix)
 
     def cond(c):
         st, it = c
@@ -302,7 +436,8 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
     def body(c):
         st, it = c
-        return one_pass(s_fix, st, it + 1000, k_cap=k_fix), it + 1
+        return one_pass(s_fix, st, it + 1000, k_cap=k_fix,
+                        sk_next=sk_fix), it + 1
 
     state, _ = jax.lax.while_loop(
         cond, body, (state, jnp.asarray(len(schedule) + 1, jnp.int32)))

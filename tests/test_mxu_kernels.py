@@ -130,3 +130,36 @@ class TestMXUGrower:
         ds, g, h = _data(n=20000, f=8, seed=3)
         t_ref, r_ref, t_mxu, r_mxu = _grow_both(ds, g, h, num_leaves=255)
         _assert_same_tree(t_ref, r_ref, t_mxu, r_mxu)
+
+    @pytest.mark.parametrize("tail_cap", [0, 2, 4])
+    def test_subtraction_matches_full_build(self, tail_cap):
+        # the sibling-subtraction path (smaller child built, larger =
+        # parent - smaller, stale parents 2 slots) must grow the same
+        # tree as building every child's histogram from rows
+        ds, g, h = _data(n=6000, f=8, seed=4, with_nan=True)
+        bins = jnp.asarray(ds.bins)
+        cnt = jnp.ones(ds.num_data, jnp.float32)
+        args = (bins, g, h, cnt, jnp.ones(ds.num_features, jnp.float32),
+                jnp.asarray(ds.num_bins), jnp.asarray(ds.missing_types == 2),
+                jnp.asarray(ds.is_categorical))
+        kw = dict(num_leaves=31, max_depth=0,
+                  hp=SplitHyperParams(min_data_in_leaf=20),
+                  bmax=int(ds.num_bins.max()), interpret=True,
+                  tail_split_cap=tail_cap)
+        t0, r0 = grow_tree_mxu(*args, hist_subtraction=False, **kw)
+        t1, r1 = grow_tree_mxu(*args, hist_subtraction=True, **kw)
+        _assert_same_tree(t0, r0, t1, r1)
+
+    def test_hybrid_tail_reaches_num_leaves(self):
+        # the throttled tail must still fill the leaf budget
+        ds, g, h = _data(n=6000, f=8, seed=5)
+        bins = jnp.asarray(ds.bins)
+        cnt = jnp.ones(ds.num_data, jnp.float32)
+        args = (bins, g, h, cnt, jnp.ones(ds.num_features, jnp.float32),
+                jnp.asarray(ds.num_bins), jnp.asarray(ds.missing_types == 2),
+                jnp.asarray(ds.is_categorical))
+        t, _ = grow_tree_mxu(
+            *args, num_leaves=31, max_depth=0,
+            hp=SplitHyperParams(min_data_in_leaf=20),
+            bmax=int(ds.num_bins.max()), interpret=True, tail_split_cap=2)
+        assert int(t.num_leaves) == 31
