@@ -324,7 +324,8 @@ class GBDT:
                 feature_fraction_bynode=cfg.feature_fraction_bynode,
                 rng_key=rng_key, hist_double_prec=cfg.gpu_use_dp,
                 tail_split_cap=cfg.tail_split_cap,
-                hist_subtraction=cfg.hist_subtraction)
+                hist_subtraction=cfg.hist_subtraction,
+                overshoot=cfg.growth_overshoot)
         if self._grower is None:
             out = grow_tree(
                 self.bins, g, h, cnt, feature_mask, self.num_bins_d,

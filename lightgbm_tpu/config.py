@@ -243,6 +243,14 @@ _PARAMS: List[ParamSpec] = [
             "child's histogram, derive the larger as parent minus smaller "
             "(~half the kernel slots per pass). false rebuilds every "
             "child's histogram from rows"),
+    _p("growth_overshoot", float, 2.0, (),
+       lambda v: v == 0.0 or v >= 1.0,
+       "overgrow-and-prune on the batched TPU grower: grow toward "
+       "overshoot*num_leaves leaves with unthrottled passes, then replay "
+       "the reference's exact best-first selection over the recorded "
+       "gains and prune (serial_tree_learner.cpp:159). Exact leaf-wise "
+       "trees when the overshoot covers every best-first pick (~3x is "
+       "ample). 0 = off (tail_split_cap hybrid growth instead)"),
     _p("tail_split_cap", int, 8, (), lambda v: v >= 0,
        "hybrid growth throttle for the batched TPU grower: once fewer "
        "leaves remain than splittable candidates, commit at most this "

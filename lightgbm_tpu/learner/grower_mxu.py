@@ -17,25 +17,133 @@ TPU reformulation of CUDADataPartition::SplitInner
 
 Feature parity vs grow_tree: numerical + categorical splits, NaN routing,
 monotone constraints, interaction constraints, feature_fraction_bynode,
-extra_trees. Not supported here (callers fall back to grow_tree): forced
-splits, CEGB, distributed comm, leafwise order.
+extra_trees. Best-first (leaf-wise) growth order is recovered by
+overgrow-and-prune (`overshoot`, default via growth_overshoot) or
+approximated by the hybrid tail throttle (`tail_split_cap`). Not
+supported here (callers fall back to grow_tree): forced splits, CEGB,
+distributed comm.
 """
 
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from .grower import _init_tree, TreeArrays
-from .histogram_mxu import (_round_up, build_histograms_mxu,
+from .histogram_mxu import (_round_up, build_histograms_mxu_auto, fits_v2,
+                            fused_route_hist_mxu, node_values_mxu,
                             pack_route_tables, route_rows_mxu)
 from .split import (BestSplits, SplitHyperParams, find_best_splits,
                     leaf_output)
 
 __all__ = ["grow_tree_mxu"]
+
+
+def _prune_to_best_first(tree: TreeArrays, row_node: jax.Array, *,
+                         num_leaves: int, m_grow: int,
+                         interpret: bool) -> Tuple[TreeArrays, jax.Array]:
+    """Replay the reference's strict best-first growth order
+    (serial_tree_learner.cpp:159-210) over an OVERGROWN tree's recorded
+    split gains, keep the winning num_leaves-1 splits, and compact.
+
+    The grower expands ~overshoot*num_leaves leaves in batched passes
+    (cheap on the MXU), so every split best-first growth would consider
+    has a recorded gain; the greedy heap replay is exact whenever the
+    overshoot expanded every node best-first would pick. Runs entirely
+    on device: num_leaves-1 argmax steps over [nodes] vectors, then a
+    cumsum renumbering. Rows are remapped to their nearest kept-leaf
+    ancestor, so callers see a standard (tree, row_node) pair."""
+    m1g = m_grow + 1
+    mf = 2 * num_leaves - 1
+    mf1 = mf + 1
+    has_split = tree.left >= 0
+    gains = jnp.where(has_split, tree.gain, -jnp.inf)
+
+    # greedy selection: pop the max-gain available node, make its
+    # children available (the reference's leaf queue, with all gains
+    # known up front)
+    def sim(i, c):
+        avail, sel = c
+        j = jnp.argmax(avail)
+        ok = avail[j] > -jnp.inf
+        sel = sel.at[j].set(sel[j] | ok)
+        avail = avail.at[j].set(-jnp.inf)
+        cl = jnp.where(ok, jnp.clip(tree.left[j], 0, m_grow), m_grow)
+        cr = jnp.where(ok, jnp.clip(tree.right[j], 0, m_grow), m_grow)
+        avail = avail.at[cl].set(
+            jnp.where(cl < m_grow, gains[cl], -jnp.inf))
+        avail = avail.at[cr].set(
+            jnp.where(cr < m_grow, gains[cr], -jnp.inf))
+        return avail, sel
+
+    avail0 = jnp.full(m1g, -jnp.inf, jnp.float32).at[0].set(gains[0])
+    _, sel = jax.lax.fori_loop(0, num_leaves - 1, sim,
+                               (avail0, jnp.zeros(m1g, bool)))
+
+    # reachability closure: a node is kept iff every ancestor was
+    # selected (depth of the kept subtree < num_leaves)
+    par = jnp.clip(tree.parent, 0, m_grow)
+
+    def reach(i, kept):
+        kp = kept[par] & sel[par] & (tree.parent >= 0)
+        return kp.at[0].set(True)
+
+    kept = jax.lax.fori_loop(
+        0, num_leaves, reach, jnp.zeros(m1g, bool).at[0].set(True))
+    final_leaf = kept & ~sel
+
+    # rows sit in overgrown leaves; ascend to the nearest kept leaf
+    def ascend(i, rm):
+        up = jnp.where(tree.parent[rm] >= 0, tree.parent[rm], rm)
+        return jnp.where(final_leaf[rm], rm, up)
+
+    remap = jax.lax.fori_loop(0, m_grow, ascend,
+                              jnp.arange(m1g, dtype=jnp.int32))
+
+    # compact: renumber kept nodes densely (order-preserving, root = 0)
+    new_id = jnp.cumsum(kept.astype(jnp.int32)) - 1
+    dst = jnp.where(kept, jnp.clip(new_id, 0, mf), mf)
+
+    def compact(arr, fill):
+        out = jnp.full((mf1,) + arr.shape[1:], fill, arr.dtype)
+        return out.at[dst].set(arr)
+
+    def child_new(c):
+        cc = jnp.clip(c, 0, m_grow)
+        return jnp.where(sel & (c >= 0), new_id[cc], -1)
+
+    parent_new = jnp.where(tree.parent >= 0, new_id[par], -1)
+    pruned = TreeArrays(
+        split_feature=compact(
+            jnp.where(sel, tree.split_feature, -1), -1),
+        threshold_bin=compact(jnp.where(sel, tree.threshold_bin, 0), 0),
+        default_left=compact(sel & tree.default_left, False),
+        is_cat=compact(sel & tree.is_cat, False),
+        cat_bitset=compact(
+            jnp.where(sel[:, None], tree.cat_bitset, 0), 0),
+        left=compact(child_new(tree.left), -1),
+        right=compact(child_new(tree.right), -1),
+        parent=compact(parent_new, -1),
+        leaf_value=compact(tree.leaf_value, 0.0),
+        sum_grad=compact(tree.sum_grad, 0.0),
+        sum_hess=compact(tree.sum_hess, 0.0),
+        count=compact(tree.count, 0.0),
+        gain=compact(jnp.where(sel, tree.gain, 0.0), 0.0),
+        depth=compact(tree.depth, 0),
+        is_leaf=compact(final_leaf, False),
+        num_nodes=jnp.sum(kept.astype(jnp.int32)),
+        num_leaves=jnp.sum(final_leaf.astype(jnp.int32)))
+
+    # per-row lookup of the compacted kept-leaf id (exact hi/lo one-hot
+    # matmul; ids < 2*num_leaves are f32-exact)
+    composed = new_id[remap].astype(jnp.float32)
+    row_new = node_values_mxu(row_node, composed,
+                              interpret=interpret).astype(jnp.int32)
+    return pruned, row_new
 
 
 def _kernel_cap(s: int) -> int:
@@ -62,7 +170,7 @@ def _select_rows(onehot: jax.Array, table: jax.Array) -> jax.Array:
     static_argnames=("num_leaves", "max_depth", "hp", "bmax",
                      "interaction_groups", "feature_fraction_bynode",
                      "interpret", "hist_double_prec", "tail_split_cap",
-                     "hist_subtraction"))
+                     "hist_subtraction", "overshoot"))
 def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   cnt_weight: jax.Array, feature_mask: jax.Array,
                   num_bins: jax.Array, missing_is_nan: jax.Array,
@@ -75,7 +183,8 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   interpret: bool = False,
                   hist_double_prec: bool = True,
                   tail_split_cap: int = 0,
-                  hist_subtraction: bool = True
+                  hist_subtraction: bool = True,
+                  overshoot: float = 0.0
                   ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree; same contract as grower.grow_tree (serial mode).
 
@@ -97,11 +206,19 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     (2 slots), and split selection is throttled so the per-pass slot cost
     fits the kernel capacity (~s/2 instead of s slots per pass)."""
     n, f = bins.shape
-    m = 2 * num_leaves - 1
+    # overshoot > 1 switches to overgrow-and-prune: grow toward
+    # overshoot*num_leaves leaves with unthrottled batched passes, then
+    # replay the exact best-first selection over the recorded gains
+    # (_prune_to_best_first). Replaces the tail throttle entirely.
+    over = overshoot if overshoot and overshoot > 1.0 else 0.0
+    if over:
+        tail_split_cap = 0
+    L_g = int(math.ceil(num_leaves * over)) if over else num_leaves
+    m = 2 * L_g - 1
     m1 = m + 1
     m_pad = _round_up(m1, 128)
-    s_max = num_leaves + 1
-    k_top = num_leaves - 1
+    s_max = L_g + 1
+    k_top = L_g - 1
     w_cat = (bmax + 31) // 32
     P_all = (s_max + 1) // 2 + 2   # pair-state capacity (subtraction)
 
@@ -151,12 +268,30 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # block fits comfortably in VMEM, narrower for big frontiers
         return dict(row_block=2048, fchunk=7 if s <= 64 else 4)
 
+    def sweep(row_node, tbl_c, member_c, nslots):
+        """Route rows through the previous pass's packed tables and build
+        the frontier histograms — fused single sweep when the histogram
+        block fits VMEM, else the two-kernel fallback (wide datasets)."""
+        if fits_v2(nslots, f, bmax, hist_double_prec):
+            return fused_route_hist_mxu(
+                bins, grad, hess, cnt_weight, row_node, tbl_c, member_c,
+                feat_tbl, num_slots=nslots, bmax=bmax,
+                has_cat=hp.has_categorical,
+                double_prec=hist_double_prec, interpret=interpret)
+        rn, rs = route_rows_mxu(bins, row_node, tbl_c, member_c, feat_tbl,
+                                interpret=interpret)
+        h = build_histograms_mxu_auto(
+            bins, grad, hess, cnt_weight, rs, num_slots=nslots, bmax=bmax,
+            interpret=interpret, double_prec=hist_double_prec,
+            **hist_cfg(nslots))
+        return h, rn
+
     def one_pass(s, st, pass_idx, k_cap=None, sk_next=None):
         """One growth pass at scan capacity `s` (python int). sk_next is
         the kernel-slot capacity of the NEXT pass (selection is throttled
         so committed splits' children fit it)."""
-        (tree, row_node, row_slot, slot_nodes, best, cons_min, cons_max,
-         path_mask, done, scan_hist, pair_parent, pair_sleft,
+        (tree, row_node, tbl_c, member_c, slot_nodes, best, cons_min,
+         cons_max, path_mask, done, scan_hist, pair_parent, pair_sleft,
          pair_kstart) = st
         sn = slot_nodes[:s]
         if sk_next is None:
@@ -167,10 +302,7 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             # build only the slots assigned by the previous pass (smaller
             # siblings + both children of stale parents) ...
             sk = _kernel_cap(s)
-            kern = build_histograms_mxu(
-                bins, grad, hess, cnt_weight, row_slot, num_slots=sk,
-                bmax=bmax, interpret=interpret,
-                double_prec=hist_double_prec, **hist_cfg(sk))
+            kern, row_node = sweep(row_node, tbl_c, member_c, sk)
             # ... and reconstruct the full scan tensor [s, F, B, 3]:
             # larger sibling = parent - smaller (exact one-hot row pulls)
             npairs = (s + 1) // 2
@@ -196,10 +328,7 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 jnp.zeros((s_max, f, bmax, 3), jnp.float32), hist,
                 (0, 0, 0, 0))
         else:
-            hist = build_histograms_mxu(
-                bins, grad, hess, cnt_weight, row_slot, num_slots=s,
-                bmax=bmax, interpret=interpret,
-                double_prec=hist_double_prec, **hist_cfg(s))
+            hist, row_node = sweep(row_node, tbl_c, member_c, s)
 
         slot_fmask = jnp.broadcast_to(feature_mask[None, :], (s, f))
         if use_bynode:
@@ -239,7 +368,7 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         if max_depth > 0:
             eligible &= tree.depth < max_depth
         gains = jnp.where(eligible[:m], best.gain[:m], -jnp.inf)
-        budget = num_leaves - tree.num_leaves
+        budget = L_g - tree.num_leaves
         if k_cap is None:
             k_cap = min(k_top, s)  # children fill the next pass (2*s)
         k_allowed = jnp.minimum(jnp.asarray(k_cap, jnp.int32), budget)
@@ -365,24 +494,34 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             .at[child_r].set(jnp.where(split_mask, route_r, -1)) \
             .at[m].set(-1)
 
-        # ---- route rows through the new splits (Pallas kernel)
-        tbl, member = pack_route_tables(
+        # ---- pack the split tables; the NEXT pass's fused sweep routes
+        # rows through them (the final flush after the loops applies the
+        # last pass's tables — routing is idempotent, see
+        # fused_route_hist_mxu)
+        tbl_c, member_c = pack_route_tables(
             split_mask, jnp.clip(feat, 0, f - 1), best.threshold_bin,
             best.default_left, new_tree.is_cat, child_l, child_r,
             slot_of_node, new_tree.cat_bitset, m_pad, bmax)
-        row_node, row_slot = route_rows_mxu(
-            bins, row_node, tbl, member, feat_tbl, interpret=interpret)
 
-        done = (k == 0) | (new_tree.num_leaves >= num_leaves)
-        return (new_tree, row_node, row_slot, slot_nodes, new_best,
+        done = (k == 0) | (new_tree.num_leaves >= L_g)
+        return (new_tree, row_node, tbl_c, member_c, slot_nodes, new_best,
                 cons_min, cons_max, path_mask, done, scan_hist,
                 pair_parent, pair_sleft, pair_kstart)
 
-    # pair 0 of the first pass is the root, built as a "stale" pair so
-    # its histogram comes straight from kernel slot 0 (no parent exists)
+    # initial tables: nothing split, root (node 0) sits in kernel slot 0,
+    # so the first sweep is an identity route + a root histogram. Pair 0
+    # of the first pass is the root, built as a "stale" pair so its
+    # histogram comes straight from kernel slot 0 (no parent exists)
+    tbl0, member0 = pack_route_tables(
+        jnp.zeros(m1, bool), jnp.zeros(m1, jnp.int32),
+        jnp.zeros(m1, jnp.int32), jnp.zeros(m1, bool),
+        jnp.zeros(m1, bool), jnp.full(m1, m, jnp.int32),
+        jnp.full(m1, m, jnp.int32),
+        jnp.full(m1, -1, jnp.int32).at[0].set(0),
+        jnp.zeros((m1, w_cat), jnp.uint32), m_pad, bmax)
     state = (tree0,
              jnp.zeros(n, jnp.int32),                     # row_node
-             jnp.zeros(n, jnp.int32),                     # row_slot
+             tbl0, member0,                               # route tables
              jnp.full(s_max, m, jnp.int32).at[0].set(0),  # slot_nodes
              best0,
              jnp.full(m1, -jnp.inf, jnp.float32),
@@ -395,12 +534,14 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
              jnp.full(P_all, True),                        # pair_sleft
              jnp.full(P_all, -1, jnp.int32).at[0].set(0))  # pair_kstart
 
+    _DONE = 9  # index of the done flag in the state tuple
+
     def cond_pass(s, st, pass_idx, k_cap=None, sk_next=None):
         # skip whole passes once growth is done — e.g. the full-capacity
         # bridge pass after a tree that completed on schedule (a free
         # S=s_max histogram otherwise)
         return jax.lax.cond(
-            st[8], lambda st_: st_,
+            st[_DONE], lambda st_: st_,
             lambda st_: one_pass(s, st_, pass_idx, k_cap, sk_next), st)
 
     # ---- unrolled doubling schedule ----
@@ -432,7 +573,7 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
     def cond(c):
         st, it = c
-        return (~st[8]) & (it < num_leaves)
+        return (~st[_DONE]) & (it < L_g)
 
     def body(c):
         st, it = c
@@ -441,4 +582,13 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
     state, _ = jax.lax.while_loop(
         cond, body, (state, jnp.asarray(len(schedule) + 1, jnp.int32)))
-    return state[0], state[1]
+
+    # flush the routing of the last pass's splits (sweeps route at the
+    # START of a pass, so the final commits have not moved rows yet)
+    row_node, _ = route_rows_mxu(bins, state[1], state[2], state[3],
+                                 feat_tbl, interpret=interpret)
+    if over:
+        return _prune_to_best_first(state[0], row_node,
+                                    num_leaves=num_leaves, m_grow=m,
+                                    interpret=interpret)
+    return state[0], row_node

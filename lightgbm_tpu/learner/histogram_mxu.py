@@ -40,12 +40,18 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["build_histograms_mxu", "route_rows_mxu", "pack_route_tables",
-           "node_values_mxu"]
+__all__ = ["build_histograms_mxu", "build_histograms_mxu_v2",
+           "build_histograms_mxu_auto", "route_rows_mxu",
+           "pack_route_tables", "node_values_mxu"]
 
 # v5e has 128 MB VMEM; the default 16 MB scoped limit starves the
 # accumulate-in-VMEM histogram output on small row counts
 _COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+
+# features per accumulating dot in the v2/fused kernels: batching widens
+# the MXU output tile (a [nb, C*S] x [nb, G*B] dot instead of G narrow
+# ones), measured ~15% faster at small S on v5e
+_FGROUP = 4
 
 
 def _round_up(x: int, k: int) -> int:
@@ -104,6 +110,128 @@ def _hist_kernel(nb: int, fc: int, b: int, s: int, flane: int,
     return kernel
 
 
+def _hist_channels(grad, hess, cnt, double_prec: bool):
+    """Channel matrix [N, 8] for the histogram kernels (hi/lo bf16 pairs
+    + count, or grad-hi/lo + single-bf16 hessian + count)."""
+    g = grad.astype(jnp.float32)
+    h = hess.astype(jnp.float32)
+    # reduce_precision (not a bf16 round-trip, which XLA elides under
+    # --xla_allow_excess_precision) keeps the hi/lo split honest
+    g_hi = jax.lax.reduce_precision(g, exponent_bits=8, mantissa_bits=7)
+    if double_prec:
+        h_hi = jax.lax.reduce_precision(h, exponent_bits=8, mantissa_bits=7)
+        chans = [g_hi, g - g_hi, h_hi, h - h_hi, cnt.astype(jnp.float32)]
+    else:
+        # mixed precision: gradient sums (the squared gain numerator) stay
+        # hi/lo-exact, hessian sums ride single bf16 — the denominator is
+        # smoothed by lambda_l2/min_hessian and tolerates ~2^-9 error
+        chans = [g_hi, g - g_hi, h, cnt.astype(jnp.float32)]
+    nchan = len(chans)
+    data = jnp.stack(chans + [jnp.zeros_like(g)] * (8 - nchan),
+                     axis=1)                                 # [N, 8]
+    return data, nchan
+
+
+def _hist_accumulate(hist_ref, slot, bins_i, data, *, nb: int, f: int,
+                     b: int, s: int, nchan: int, mm_dtype):
+    """Shared accumulation body of the v2/fused kernels: slot-masked
+    channel operand, per-feature-group bin one-hots, accumulating dots.
+    slot: [nb, 1] i32 (-1 = no slot); bins_i: [nb, lanes] i32."""
+    iota_s = jax.lax.broadcasted_iota(jnp.int32, (nb, s), 1)
+    slot_oh = (slot == iota_s)                               # [nb, S] bool
+    lhs = jnp.concatenate(
+        [jnp.where(slot_oh, data[:, c:c + 1], jnp.float32(0.0))
+         for c in range(nchan)], axis=1).astype(mm_dtype)    # [nb, C*S]
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (nb, b), 1)
+    for gj in range(0, f, _FGROUP):
+        js = range(gj, min(gj + _FGROUP, f))
+        oh = jnp.concatenate(
+            [(bins_i[:, j:j + 1] == iota_b) for j in js],
+            axis=1).astype(mm_dtype)                         # [nb, G*B]
+        part = jax.lax.dot_general(
+            lhs, oh, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [C*S, G*B]
+        hist_ref[0, :, gj * b:(gj + len(js)) * b] += part
+
+
+def _route_decide(node, gath, bins_blk, ftbl, memb, *, nb: int,
+                  lanes: int):
+    """Shared split-decision math of the route/fused kernels: numerical
+    thresholds, NaN-bin default direction, categorical bitset membership.
+    gath: [nb, K] node-table row per row; bins_blk: [nb, lanes] f32;
+    memb: [nb, Bpad] categorical left-set membership or None when the
+    table holds no categorical splits. Returns new node ids [nb, 1] f32
+    (rows of unsplit nodes keep their node)."""
+
+    def col(c):
+        return gath[:, c:c + 1]                              # [nb, 1] f32
+
+    split = col(_COL_SPLIT)
+    pf = col(_COL_FEAT_Q) * 256.0 + col(_COL_FEAT_R)
+    thr = col(_COL_THR)
+    defl = col(_COL_DEFLEFT) > 0.5
+    child_l = col(_COL_LEFT_Q) * 256.0 + col(_COL_LEFT_R)
+    child_r = col(_COL_RIGHT_Q) * 256.0 + col(_COL_RIGHT_R)
+
+    # column select: binv[r] = bins[r, pf[r]] via one-hot mask-sum
+    iota_f = jax.lax.broadcasted_iota(jnp.int32, (nb, lanes), 1) \
+        .astype(jnp.float32)
+    feat_oh = (pf == iota_f)                                 # [nb, L] bool
+    binv = jnp.sum(jnp.where(feat_oh, bins_blk, 0.0), axis=1,
+                   keepdims=True)                            # [nb, 1] f32
+    # per-feature flags (num_bins, missing_is_nan), same mask
+    nbins = jnp.sum(jnp.where(feat_oh, ftbl[:, 0][None, :], 0.0),
+                    axis=1, keepdims=True)
+    mnan = jnp.sum(jnp.where(feat_oh, ftbl[:, 1][None, :], 0.0),
+                   axis=1, keepdims=True) > 0.5
+    is_nan_bin = mnan & (binv == nbins - 1.0)
+
+    # predicates as 0/1 f32 (Mosaic lacks i1-valued selects)
+    one = jnp.float32(1.0)
+    zero = jnp.float32(0.0)
+    nan_f = jnp.where(is_nan_bin, one, zero)
+    defl_f = jnp.where(defl, one, zero)
+    le_f = jnp.where(binv <= thr, one, zero)
+    num_gl = nan_f * defl_f + (one - nan_f) * le_f
+    if memb is not None:
+        iscat_f = jnp.where(col(_COL_ISCAT) > 0.5, one, zero)
+        bpad = memb.shape[1]
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (nb, bpad), 1) \
+            .astype(jnp.float32)
+        in_set_f = jnp.sum(jnp.where(binv == iota_b, memb, 0.0),
+                           axis=1, keepdims=True)            # 0/1 f32
+        gl_f = iscat_f * in_set_f + (one - iscat_f) * num_gl
+    else:
+        gl_f = num_gl
+    child_f = gl_f * child_l + (one - gl_f) * child_r
+    return split * child_f + (one - split) * node.astype(jnp.float32)
+
+
+def _hist_kernel_v2(nb: int, f: int, b: int, s: int,
+                    mm_dtype=jnp.bfloat16, nchan: int = 5):
+    """Extraction-free histogram kernel: the [flane, fc*B] selector matmul
+    of _hist_kernel (whose cost scales with the 128-lane padding, ~4.6x
+    waste at F=28 and the S-independent floor of every pass) is replaced
+    by per-feature static lane slices + a VPU broadcast-compare. One grid
+    pass over rows, one [nb, nchan*S] x [nb, B] dot per feature."""
+
+    def kernel(block_any_ref, slot_ref, bins_ref, data_ref, out_ref):
+        ri = pl.program_id(0)
+
+        @pl.when(ri == 0)
+        def _():
+            out_ref[0] = jnp.zeros_like(out_ref[0])
+
+        @pl.when(block_any_ref[ri] != 0)
+        def _():
+            _hist_accumulate(out_ref, slot_ref[:],
+                             bins_ref[:].astype(jnp.int32), data_ref[:],
+                             nb=nb, f=f, b=b, s=s, nchan=nchan,
+                             mm_dtype=mm_dtype)
+
+    return kernel
+
+
 @functools.partial(
     jax.jit, static_argnames=("num_slots", "bmax", "row_block", "fchunk",
                               "interpret", "use_f32", "double_prec"))
@@ -146,22 +274,7 @@ def build_histograms_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     if npad:
         slot = jnp.pad(slot, (0, npad), constant_values=-1)
 
-    g = grad.astype(jnp.float32)
-    h = hess.astype(jnp.float32)
-    # reduce_precision (not a bf16 round-trip, which XLA elides under
-    # --xla_allow_excess_precision) keeps the hi/lo split honest
-    g_hi = jax.lax.reduce_precision(g, exponent_bits=8, mantissa_bits=7)
-    if double_prec:
-        h_hi = jax.lax.reduce_precision(h, exponent_bits=8, mantissa_bits=7)
-        chans = [g_hi, g - g_hi, h_hi, h - h_hi, cnt.astype(jnp.float32)]
-    else:
-        # mixed precision: gradient sums (the squared gain numerator) stay
-        # hi/lo-exact, hessian sums ride single bf16 — the denominator is
-        # smoothed by lambda_l2/min_hessian and tolerates ~2^-9 error
-        chans = [g_hi, g - g_hi, h, cnt.astype(jnp.float32)]
-    nchan = len(chans)
-    data = jnp.stack(chans + [jnp.zeros_like(g)] * (8 - nchan),
-                     axis=1)                                 # [N, 8]
+    data, nchan = _hist_channels(grad, hess, cnt, double_prec)
     if npad:
         data = jnp.pad(data, ((0, npad), (0, 0)))
 
@@ -200,6 +313,252 @@ def build_histograms_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         hist = jnp.stack([out[:, 0] + out[:, 1], out[:, 2], out[:, 3]],
                          axis=-1)
     return hist
+
+
+# VMEM budget for the v2 kernel's resident output block; beyond it the
+# chunked v1 kernel takes over (wide-feature datasets)
+_V2_OUT_BYTES = 48 * 1024 * 1024
+
+
+def fits_v2(num_slots: int, num_features: int, bmax: int,
+            double_prec: bool = True) -> bool:
+    """Whether the extraction-free v2/fused kernels' resident histogram
+    block fits the VMEM budget for this shape (single owner of the
+    predicate — the grower and the auto dispatcher must agree)."""
+    b = ((bmax + 127) // 128) * 128
+    nchan = 5 if double_prec else 4
+    return nchan * num_slots * num_features * b * 4 <= _V2_OUT_BYTES
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_slots", "bmax", "row_block",
+                              "interpret", "use_f32", "double_prec"))
+def build_histograms_mxu_v2(bins: jax.Array, grad: jax.Array,
+                            hess: jax.Array, cnt: jax.Array,
+                            row_slot: jax.Array, *, num_slots: int,
+                            bmax: int, row_block: int = 4096,
+                            use_f32: bool = False,
+                            double_prec: bool = True,
+                            interpret: bool = False) -> jax.Array:
+    """Extraction-free variant of build_histograms_mxu (same contract):
+    one grid pass over rows, per-feature static lane slices instead of
+    the selector matmul, all channels in a single dot per feature."""
+    n, f = bins.shape
+    nb = row_block
+    s = num_slots
+    b = ((bmax + 127) // 128) * 128
+    flane = ((f + 127) // 128) * 128
+
+    npad = (-n) % nb
+    if npad:
+        bins = jnp.pad(bins, ((0, npad), (0, 0)))
+    if flane != f:
+        # padded lanes are never sliced by the kernel (j < f); the value
+        # only needs to be in-range for the int cast
+        bins = jnp.pad(bins, ((0, 0), (0, flane - f)))
+    slot = jnp.where((row_slot < 0) | (row_slot >= s), -1, row_slot) \
+        .astype(jnp.int32)
+    if npad:
+        slot = jnp.pad(slot, (0, npad), constant_values=-1)
+    data, nchan = _hist_channels(grad, hess, cnt, double_prec)
+    if npad:
+        data = jnp.pad(data, ((0, npad), (0, 0)))
+
+    nblocks = (n + npad) // nb
+    block_any = jnp.max(
+        (slot >= 0).astype(jnp.int32).reshape(nblocks, nb), axis=1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec((nb, 1), lambda ri, ba: (ri, 0)),
+            pl.BlockSpec((nb, flane), lambda ri, ba: (ri, 0)),
+            pl.BlockSpec((nb, 8), lambda ri, ba: (ri, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, nchan * s, f * b),
+                               lambda ri, ba: (0, 0, 0)))
+    out = pl.pallas_call(
+        _hist_kernel_v2(nb, f, b, s,
+                        jnp.float32 if use_f32 else jnp.bfloat16,
+                        nchan=nchan),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((1, nchan * s, f * b), jnp.float32),
+        interpret=interpret,
+        **({} if interpret else {"compiler_params": _COMPILER_PARAMS}),
+    )(block_any, slot[:, None], bins, data)
+
+    out = out.reshape(nchan, s, f, b)[..., :bmax]
+    out = jnp.transpose(out, (1, 0, 2, 3))                   # [S, C, F, B]
+    if double_prec:
+        hist = jnp.stack([out[:, 0] + out[:, 1], out[:, 2] + out[:, 3],
+                          out[:, 4]], axis=-1)               # [S, F, B, 3]
+    else:
+        hist = jnp.stack([out[:, 0] + out[:, 1], out[:, 2], out[:, 3]],
+                         axis=-1)
+    return hist
+
+
+def build_histograms_mxu_auto(bins, grad, hess, cnt, row_slot, *,
+                              num_slots, bmax, double_prec=True,
+                              interpret=False, **v1_cfg):
+    """v2 kernel when its per-feature output block fits VMEM, else the
+    chunked v1 kernel (wide-feature datasets)."""
+    f = bins.shape[1]
+    if fits_v2(num_slots, f, bmax, double_prec):
+        return build_histograms_mxu_v2(
+            bins, grad, hess, cnt, row_slot, num_slots=num_slots,
+            bmax=bmax, double_prec=double_prec, interpret=interpret)
+    return build_histograms_mxu(
+        bins, grad, hess, cnt, row_slot, num_slots=num_slots, bmax=bmax,
+        double_prec=double_prec, interpret=interpret, **v1_cfg)
+
+
+def _fused_kernel(nb: int, f: int, flane: int, b: int, s: int, m: int,
+                  bpad: int, mm_dtype=jnp.bfloat16, nchan: int = 5,
+                  has_cat: bool = True):
+    """Route + histogram in ONE sweep over the binned matrix: advance each
+    row through the splits committed by the previous pass (the
+    _route_kernel math) and immediately scatter-accumulate it into its new
+    slot's histogram (the _hist_kernel_v2 math). Saves a full second read
+    of bins + a kernel launch per growth pass. Blocks whose rows all sit
+    in unsplit nodes skip everything except the cheap node-table gather
+    (their rows keep their node and contribute to no slot)."""
+
+    def kernel(node_ref, bins_ref, data_ref, tbl_ref, member_ref,
+               feat_tbl_ref, hist_ref, node_out_ref):
+        ri = pl.program_id(0)
+
+        @pl.when(ri == 0)
+        def _():
+            hist_ref[0] = jnp.zeros_like(hist_ref[0])
+
+        node = node_ref[:]                                   # [nb, 1] i32
+        iota_m = jax.lax.broadcasted_iota(jnp.int32, (nb, m), 1)
+        node_oh = (node == iota_m).astype(jnp.float32)       # [nb, M]
+        gath = jax.lax.dot_general(
+            node_oh, tbl_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [nb, K]
+
+        def col(c):
+            return gath[:, c:c + 1]                          # [nb, 1] f32
+
+        split = col(_COL_SPLIT)
+        block_has_split = jnp.sum(split) > 0.5
+
+        @pl.when(~block_has_split)
+        def _():
+            node_out_ref[:] = node
+
+        @pl.when(block_has_split)
+        def _():
+            memb = jax.lax.dot_general(
+                node_oh, member_ref[:],
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) if has_cat else None
+            new_node_f = _route_decide(
+                node, gath, bins_ref[:].astype(jnp.int32)
+                .astype(jnp.float32), feat_tbl_ref[:], memb,
+                nb=nb, lanes=flane)
+            node_out_ref[:] = new_node_f.astype(jnp.int32)
+
+        # ---- histogram accumulation for every block holding slotted
+        # rows. Slots come from the (just-written) new node: unsplit
+        # nodes carry slot -1 in the table except the initial root pass,
+        # so this also covers blocks the route skipped.
+        new_node = node_out_ref[:]                           # [nb, 1] i32
+        new_oh = (new_node == iota_m).astype(jnp.float32)
+        qr = jax.lax.dot_general(
+            new_oh, tbl_ref[:, _COL_SLOT_Q:_COL_SLOT_R + 1],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [nb, 2]
+        slot = (qr[:, 0:1] * 256.0 + qr[:, 1:2]).astype(jnp.int32)
+        block_any_slot = jnp.max(slot) >= 0
+
+        @pl.when(block_any_slot)
+        def _():
+            _hist_accumulate(hist_ref, slot,
+                             bins_ref[:].astype(jnp.int32), data_ref[:],
+                             nb=nb, f=f, b=b, s=s, nchan=nchan,
+                             mm_dtype=mm_dtype)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_slots", "bmax", "row_block", "has_cat",
+                              "double_prec", "interpret"))
+def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
+                         cnt: jax.Array, row_node: jax.Array,
+                         tbl: jax.Array, member: jax.Array,
+                         feat_tbl: jax.Array, *, num_slots: int, bmax: int,
+                         row_block: int = 4096, has_cat: bool = True,
+                         double_prec: bool = True,
+                         interpret: bool = False):
+    """One sweep: route rows through the previous pass's packed split
+    tables (pack_route_tables) AND build the per-slot histograms of the
+    resulting frontier. Returns (hist [S, F, bmax, 3], new row_node [N]).
+
+    Rows whose node did not split keep their node and land in no slot
+    (slot -1), matching route_rows_mxu + build_histograms_mxu. Routing is
+    idempotent: a second sweep through the same tables is the identity
+    (children are not split in the table), which the grower uses to flush
+    the final pass's routing after its loops."""
+    n, f = bins.shape
+    nb = row_block
+    s = num_slots
+    b = ((bmax + 127) // 128) * 128
+    flane = ((f + 127) // 128) * 128
+    m, kcols = tbl.shape
+    bpad = member.shape[1]
+
+    npad = (-n) % nb
+    if npad:
+        bins = jnp.pad(bins, ((0, npad), (0, 0)))
+        row_node = jnp.pad(row_node, (0, npad))
+    if flane != f:
+        bins = jnp.pad(bins, ((0, 0), (0, flane - f)))
+    if feat_tbl.shape[0] != flane:
+        feat_tbl = jnp.pad(feat_tbl,
+                           ((0, flane - feat_tbl.shape[0]), (0, 0)))
+    data, nchan = _hist_channels(grad, hess, cnt, double_prec)
+    if npad:
+        data = jnp.pad(data, ((0, npad), (0, 0)))
+
+    nblocks = (n + npad) // nb
+    hist, node_out = pl.pallas_call(
+        _fused_kernel(nb, f, flane, b, s, m, bpad, nchan=nchan,
+                      has_cat=has_cat),
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec((nb, 1), lambda ri: (ri, 0)),
+            pl.BlockSpec((nb, flane), lambda ri: (ri, 0)),
+            pl.BlockSpec((nb, 8), lambda ri: (ri, 0)),
+            pl.BlockSpec((m, kcols), lambda ri: (0, 0)),
+            pl.BlockSpec((m, bpad), lambda ri: (0, 0)),
+            pl.BlockSpec((flane, 2), lambda ri: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, nchan * s, f * b), lambda ri: (0, 0, 0)),
+            pl.BlockSpec((nb, 1), lambda ri: (ri, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, nchan * s, f * b), jnp.float32),
+            jax.ShapeDtypeStruct((n + npad, 1), jnp.int32),
+        ],
+        interpret=interpret,
+        **({} if interpret else {"compiler_params": _COMPILER_PARAMS}),
+    )(row_node.astype(jnp.int32)[:, None], bins, data, tbl, member,
+      feat_tbl)
+
+    out = hist.reshape(nchan, s, f, b)[..., :bmax]
+    out = jnp.transpose(out, (1, 0, 2, 3))                   # [S, C, F, B]
+    if double_prec:
+        h3 = jnp.stack([out[:, 0] + out[:, 1], out[:, 2] + out[:, 3],
+                        out[:, 4]], axis=-1)                 # [S, F, B, 3]
+    else:
+        h3 = jnp.stack([out[:, 0] + out[:, 1], out[:, 2], out[:, 3]],
+                       axis=-1)
+    return h3, node_out[:n, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -262,7 +621,8 @@ def pack_route_tables(split_mask, feat, thr, default_left, is_cat,
     return tbl, member
 
 
-def _route_kernel(nb: int, f: int, m: int, bpad: int):
+def _route_kernel(nb: int, f: int, m: int, bpad: int,
+                  has_cat: bool = True):
     # every per-row quantity is kept [nb, 1] (2-D) — Mosaic lowers 2-D
     # masks/selects cleanly where 1-D bool vectors hit unsupported i1 casts
     def kernel(node_ref, bins_ref, tbl_ref, member_ref, feat_tbl_ref,
@@ -274,9 +634,6 @@ def _route_kernel(nb: int, f: int, m: int, bpad: int):
             node_oh, tbl_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)              # [nb, K]
 
-        def col(c):
-            return gath[:, c:c + 1]                          # [nb, 1] f32
-
         def slot_of(node_f):
             oh = (node_f.astype(jnp.int32) == iota_m).astype(jnp.float32)
             qr = jax.lax.dot_general(
@@ -285,10 +642,9 @@ def _route_kernel(nb: int, f: int, m: int, bpad: int):
                 preferred_element_type=jnp.float32)          # [nb, 2]
             return qr[:, 0:1] * 256.0 + qr[:, 1:2]
 
-        split = col(_COL_SPLIT)
         # blocks whose rows all sit in unsplit nodes (the common case in
         # late, narrow growth passes) skip the decision math entirely
-        block_has_split = jnp.sum(split) > 0.5
+        block_has_split = jnp.sum(gath[:, _COL_SPLIT:_COL_SPLIT + 1]) > 0.5
 
         @pl.when(~block_has_split)
         def _():
@@ -298,53 +654,14 @@ def _route_kernel(nb: int, f: int, m: int, bpad: int):
 
         @pl.when(block_has_split)
         def _():
-            pf = col(_COL_FEAT_Q) * 256.0 + col(_COL_FEAT_R)
-            thr = col(_COL_THR)
-            defl = col(_COL_DEFLEFT) > 0.5
-            iscat = col(_COL_ISCAT) > 0.5
-            child_l = col(_COL_LEFT_Q) * 256.0 + col(_COL_LEFT_R)
-            child_r = col(_COL_RIGHT_Q) * 256.0 + col(_COL_RIGHT_R)
-
-            # column select: binv[r] = bins[r, pf[r]] via one-hot mask-sum
-            bins_blk = bins_ref[:].astype(jnp.int32) \
-                .astype(jnp.float32)                         # [nb, F]
-            iota_f = jax.lax.broadcasted_iota(jnp.int32, (nb, f), 1) \
-                .astype(jnp.float32)
-            feat_oh = (pf == iota_f)                         # [nb, F] bool
-            binv = jnp.sum(jnp.where(feat_oh, bins_blk, 0.0), axis=1,
-                           keepdims=True)                    # [nb, 1] f32
-
-            # per-feature flags (num_bins, missing_is_nan), same mask
-            ftbl = feat_tbl_ref[:]                           # [F, 2] f32
-            nbins = jnp.sum(jnp.where(feat_oh, ftbl[:, 0][None, :], 0.0),
-                            axis=1, keepdims=True)
-            mnan = jnp.sum(jnp.where(feat_oh, ftbl[:, 1][None, :], 0.0),
-                           axis=1, keepdims=True) > 0.5
-            is_nan_bin = mnan & (binv == nbins - 1.0)
-
-            # categorical: membership of bin binv in the node's left set,
-            # via the [M, B] 0/1 member table (matmul + column select)
             memb = jax.lax.dot_general(
                 node_oh, member_ref[:],
                 dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)          # [nb, Bpad]
-            iota_b = jax.lax.broadcasted_iota(jnp.int32, (nb, bpad), 1) \
-                .astype(jnp.float32)
-            in_set_f = jnp.sum(jnp.where(binv == iota_b, memb, 0.0),
-                               axis=1, keepdims=True)        # 0/1 f32
-
-            # predicates as 0/1 f32 (Mosaic lacks i1-valued selects)
-            one = jnp.float32(1.0)
-            zero = jnp.float32(0.0)
-            iscat_f = jnp.where(iscat, one, zero)
-            nan_f = jnp.where(is_nan_bin, one, zero)
-            defl_f = jnp.where(defl, one, zero)
-            le_f = jnp.where(binv <= thr, one, zero)
-            num_gl = nan_f * defl_f + (one - nan_f) * le_f
-            gl_f = iscat_f * in_set_f + (one - iscat_f) * num_gl
-            child_f = gl_f * child_l + (one - gl_f) * child_r
-            new_node_f = split * child_f + \
-                (one - split) * node.astype(jnp.float32)     # [nb, 1]
+                preferred_element_type=jnp.float32) if has_cat else None
+            new_node_f = _route_decide(
+                node, gath, bins_ref[:].astype(jnp.int32)
+                .astype(jnp.float32), feat_tbl_ref[:], memb,
+                nb=nb, lanes=f)
             out_ref[:] = jnp.concatenate(
                 [new_node_f, slot_of(new_node_f)],
                 axis=1).astype(jnp.int32)

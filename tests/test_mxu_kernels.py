@@ -150,6 +150,40 @@ class TestMXUGrower:
         t1, r1 = grow_tree_mxu(*args, hist_subtraction=True, **kw)
         _assert_same_tree(t0, r0, t1, r1)
 
+    @pytest.mark.parametrize("overshoot", [2.0, 3.0])
+    def test_overshoot_prune_matches_leafwise(self, overshoot):
+        # overgrow-and-prune replays the exact best-first order over the
+        # recorded gains; with ample overshoot the per-row leaf outputs
+        # must match the strict leaf-wise scatter grower up to kernel
+        # precision, and the pruned tree must be self-consistent
+        from lightgbm_tpu.learner.predict import predict_binned_tree
+        ds, g, h = _data(n=6000, f=8, seed=6, with_nan=True)
+        bins = jnp.asarray(ds.bins)
+        cnt = jnp.ones(ds.num_data, jnp.float32)
+        args = (bins, g, h, cnt, jnp.ones(ds.num_features, jnp.float32),
+                jnp.asarray(ds.num_bins), jnp.asarray(ds.missing_types == 2),
+                jnp.asarray(ds.is_categorical))
+        kw = dict(num_leaves=31, max_depth=0,
+                  hp=SplitHyperParams(min_data_in_leaf=20),
+                  bmax=int(ds.num_bins.max()))
+        t_lw, r_lw = grow_tree(*args, leafwise=True, **kw)
+        t_ov, r_ov = grow_tree_mxu(*args, interpret=True,
+                                   overshoot=overshoot, **kw)
+        assert int(t_ov.num_leaves) == 31
+        # row_node agrees with routing fresh rows through the pruned tree
+        vals_route = predict_binned_tree(
+            t_ov, bins, jnp.asarray(ds.num_bins),
+            jnp.asarray(ds.missing_types == 2))
+        vals_rows = np.asarray(t_ov.leaf_value)[np.asarray(r_ov)]
+        np.testing.assert_allclose(np.asarray(vals_route), vals_rows,
+                                   rtol=1e-5, atol=1e-6)
+        # per-row outputs match strict leaf-wise growth (kernel-precision
+        # tie-breaks allowed at overshoot=2 where coverage can clip)
+        v_lw = np.asarray(t_lw.leaf_value)[np.asarray(r_lw)]
+        if overshoot >= 3.0:
+            mismatch = np.mean(np.abs(v_lw - vals_rows) > 1e-2)
+            assert mismatch < 0.02, f"row mismatch rate {mismatch}"
+
     def test_hybrid_tail_reaches_num_leaves(self):
         # the throttled tail must still fill the leaf budget
         ds, g, h = _data(n=6000, f=8, seed=5)
