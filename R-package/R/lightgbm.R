@@ -1,0 +1,206 @@
+# R surface of lightgbm_tpu via reticulate.
+#
+# Mirrors the reference R package's exported API (R-package/NAMESPACE):
+# lgb.Dataset, lgb.Dataset.construct/create.valid/save/set.categorical,
+# lgb.train, lgb.cv, lgb.load, lgb.save, lgb.dump, predict.lgb.Booster,
+# lgb.importance, lgb.get.eval.result, lightgbm(). The reference binds
+# its C API from R (lightgbm_R.cpp); this package bridges to the Python
+# core instead — parameters, model files and semantics are identical.
+
+.lgb_env <- new.env(parent = emptyenv())
+
+.lgb_py <- function() {
+  if (is.null(.lgb_env$mod)) {
+    .lgb_env$mod <- reticulate::import("lightgbm_tpu", delay_load = FALSE)
+  }
+  .lgb_env$mod
+}
+
+#' Construct a Dataset (reference lgb.Dataset, R-package/R/lgb.Dataset.R)
+#' @export
+lgb.Dataset <- function(data, params = list(), reference = NULL,
+                        label = NULL, weight = NULL, group = NULL,
+                        init_score = NULL, colnames = NULL,
+                        categorical_feature = NULL, free_raw_data = FALSE) {
+  py <- .lgb_py()
+  ds <- py$Dataset(
+    data = data, label = label, weight = weight, group = group,
+    init_score = init_score, params = params,
+    feature_name = if (is.null(colnames)) "auto" else as.list(colnames),
+    categorical_feature = if (is.null(categorical_feature)) "auto"
+                          else as.list(categorical_feature),
+    reference = reference, free_raw_data = free_raw_data)
+  class(ds) <- c("lgb.Dataset", class(ds))
+  ds
+}
+
+#' @export
+lgb.Dataset.construct <- function(dataset) {
+  dataset$construct()
+  invisible(dataset)
+}
+
+#' @export
+lgb.Dataset.create.valid <- function(dataset, data, label = NULL, ...) {
+  v <- dataset$create_valid(data = data, label = label, ...)
+  class(v) <- c("lgb.Dataset", class(v))
+  v
+}
+
+#' @export
+lgb.Dataset.save <- function(dataset, fname) {
+  dataset$save_binary(fname)
+  invisible(dataset)
+}
+
+#' @export
+lgb.Dataset.set.categorical <- function(dataset, categorical_feature) {
+  dataset$set_categorical_feature(as.list(categorical_feature))
+  invisible(dataset)
+}
+
+#' @export
+slice <- function(dataset, idxset, ...) UseMethod("slice")
+
+#' @export
+slice.lgb.Dataset <- function(dataset, idxset, ...) {
+  # Python subset() takes 0-based indices
+  s <- dataset$subset(as.integer(idxset - 1L))
+  class(s) <- c("lgb.Dataset", class(s))
+  s
+}
+
+#' @export
+get_field <- function(dataset, field_name) UseMethod("get_field")
+
+#' @export
+get_field.lgb.Dataset <- function(dataset, field_name) {
+  dataset$get_field(field_name)
+}
+
+#' @export
+set_field <- function(dataset, field_name, data) UseMethod("set_field")
+
+#' @export
+set_field.lgb.Dataset <- function(dataset, field_name, data) {
+  dataset$set_field(field_name, data)
+  invisible(dataset)
+}
+
+#' Train a model (reference lgb.train, R-package/R/lgb.train.R)
+#' @export
+lgb.train <- function(params = list(), data, nrounds = 100L,
+                      valids = list(), obj = NULL, eval = NULL,
+                      verbose = 1L, record = TRUE,
+                      eval_freq = 1L, init_model = NULL,
+                      early_stopping_rounds = NULL, callbacks = list(),
+                      ...) {
+  py <- .lgb_py()
+  if (!is.null(early_stopping_rounds)) {
+    params$early_stopping_round <- early_stopping_rounds
+  }
+  evals_result <- reticulate::dict()
+  bst <- py$train(
+    params = params, train_set = data, num_boost_round = as.integer(nrounds),
+    valid_sets = unname(valids),
+    valid_names = if (length(valids)) as.list(names(valids)) else NULL,
+    feval = eval, init_model = init_model,
+    callbacks = c(list(py$record_evaluation(evals_result)), callbacks))
+  attr(bst, "evals_result") <- evals_result
+  class(bst) <- c("lgb.Booster", class(bst))
+  bst
+}
+
+#' Cross validation (reference lgb.cv, R-package/R/lgb.cv.R)
+#' @export
+lgb.cv <- function(params = list(), data, nrounds = 100L, nfold = 3L,
+                   obj = NULL, eval = NULL, stratified = TRUE,
+                   early_stopping_rounds = NULL, ...) {
+  py <- .lgb_py()
+  if (!is.null(early_stopping_rounds)) {
+    params$early_stopping_round <- early_stopping_rounds
+  }
+  py$cv(params = params, train_set = data,
+        num_boost_round = as.integer(nrounds), nfold = as.integer(nfold),
+        stratified = stratified, feval = eval)
+}
+
+#' @export
+predict.lgb.Booster <- function(object, newdata, rawscore = FALSE,
+                                predleaf = FALSE, predcontrib = FALSE,
+                                num_iteration = NULL, ...) {
+  object$predict(newdata, raw_score = rawscore, pred_leaf = predleaf,
+                 pred_contrib = predcontrib,
+                 num_iteration = num_iteration)
+}
+
+#' @export
+print.lgb.Booster <- function(x, ...) {
+  cat("<lgb.Booster>\n")
+  cat(sprintf("  trees: %d\n", x$num_trees()))
+  invisible(x)
+}
+
+#' @export
+summary.lgb.Booster <- function(object, ...) print(object, ...)
+
+#' Load a model from file (reference lgb.load)
+#' @export
+lgb.load <- function(filename = NULL, model_str = NULL) {
+  py <- .lgb_py()
+  bst <- if (!is.null(filename)) py$Booster(model_file = filename)
+         else py$Booster(model_str = model_str)
+  class(bst) <- c("lgb.Booster", class(bst))
+  bst
+}
+
+#' Save a model to file (reference lgb.save)
+#' @export
+lgb.save <- function(booster, filename, num_iteration = NULL) {
+  booster$save_model(filename, num_iteration = num_iteration)
+  invisible(booster)
+}
+
+#' Dump model to JSON (reference lgb.dump)
+#' @export
+lgb.dump <- function(booster, num_iteration = NULL) {
+  booster$dump_model(num_iteration = num_iteration)
+}
+
+#' Feature importance (reference lgb.importance)
+#' @export
+lgb.importance <- function(model, percentage = TRUE) {
+  gain <- model$feature_importance(importance_type = "gain")
+  splits <- model$feature_importance(importance_type = "split")
+  nm <- unlist(model$feature_name())
+  out <- data.frame(Feature = nm, Gain = as.numeric(gain),
+                    Cover = NA_real_, Frequency = as.numeric(splits))
+  out <- out[order(-out$Gain), ]
+  if (percentage && sum(out$Gain) > 0) {
+    out$Gain <- out$Gain / sum(out$Gain)
+    out$Frequency <- out$Frequency / sum(out$Frequency)
+  }
+  out
+}
+
+#' @export
+lgb.get.eval.result <- function(booster, data_name, eval_name,
+                                iters = NULL, is_err = FALSE) {
+  rec <- attr(booster, "evals_result")
+  vals <- unlist(rec[[data_name]][[eval_name]])
+  if (!is.null(iters)) vals <- vals[iters]
+  vals
+}
+
+#' High-level fit, mirroring the reference lightgbm() entry point
+#' @export
+lightgbm <- function(data, label = NULL, weight = NULL, params = list(),
+                     nrounds = 100L, verbose = 1L,
+                     objective = "regression", ...) {
+  params$objective <- params$objective %||% objective
+  dtrain <- lgb.Dataset(data, label = label, weight = weight)
+  lgb.train(params = params, data = dtrain, nrounds = nrounds,
+            verbose = verbose, ...)
+}
+
+`%||%` <- function(a, b) if (is.null(a)) b else a

@@ -256,6 +256,28 @@ class Dataset:
         sub.reference = self
         return sub
 
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        """Validation Dataset aligned with this one's bin mappers
+        (reference basic.py Dataset.create_valid; the C path is
+        LoadFromFileAlignWithOtherDataset, dataset_loader.cpp:299)."""
+        return Dataset(data, label=label, weight=weight, group=group,
+                       init_score=init_score, reference=self,
+                       params=params or self.params,
+                       free_raw_data=self.free_raw_data)
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        """Change the categorical features (reference basic.py
+        set_categorical_feature); only allowed before construction."""
+        if self.categorical_feature == categorical_feature:
+            return self
+        if self._binned is not None:
+            raise LightGBMError(
+                "set_categorical_feature after Dataset construction "
+                "requires reconstructing; create a new Dataset instead")
+        self.categorical_feature = categorical_feature
+        return self
+
     def save_binary(self, filename: str) -> "Dataset":
         """Write the constructed dataset to a binary cache file
         (reference basic.py Dataset.save_binary / LGBM_DatasetSaveBinary)."""

@@ -210,7 +210,7 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # overshoot*num_leaves leaves with unthrottled batched passes, then
     # replay the exact best-first selection over the recorded gains
     # (_prune_to_best_first). Replaces the tail throttle entirely.
-    over = overshoot if overshoot and overshoot > 1.0 else 0.0
+    over = overshoot if overshoot and overshoot >= 1.0 else 0.0
     if over:
         tail_split_cap = 0
     L_g = int(math.ceil(num_leaves * over)) if over else num_leaves

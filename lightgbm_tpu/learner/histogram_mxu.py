@@ -132,6 +132,19 @@ def _hist_channels(grad, hess, cnt, double_prec: bool):
     return data, nchan
 
 
+def _combine_hist(out, *, nchan: int, s: int, f: int, b: int, bmax: int,
+                  double_prec: bool) -> jax.Array:
+    """Kernel output [*, nchan*s, f*b] -> [S, F, bmax, 3] with the hi/lo
+    channel recombination (shared postlude of the v2/fused kernels)."""
+    out = out.reshape(nchan, s, f, b)[..., :bmax]
+    out = jnp.transpose(out, (1, 0, 2, 3))                   # [S, C, F, B]
+    if double_prec:
+        return jnp.stack([out[:, 0] + out[:, 1], out[:, 2] + out[:, 3],
+                          out[:, 4]], axis=-1)               # [S, F, B, 3]
+    return jnp.stack([out[:, 0] + out[:, 1], out[:, 2], out[:, 3]],
+                     axis=-1)
+
+
 def _hist_accumulate(hist_ref, slot, bins_i, data, *, nb: int, f: int,
                      b: int, s: int, nchan: int, mm_dtype):
     """Shared accumulation body of the v2/fused kernels: slot-masked
@@ -387,15 +400,8 @@ def build_histograms_mxu_v2(bins: jax.Array, grad: jax.Array,
         **({} if interpret else {"compiler_params": _COMPILER_PARAMS}),
     )(block_any, slot[:, None], bins, data)
 
-    out = out.reshape(nchan, s, f, b)[..., :bmax]
-    out = jnp.transpose(out, (1, 0, 2, 3))                   # [S, C, F, B]
-    if double_prec:
-        hist = jnp.stack([out[:, 0] + out[:, 1], out[:, 2] + out[:, 3],
-                          out[:, 4]], axis=-1)               # [S, F, B, 3]
-    else:
-        hist = jnp.stack([out[:, 0] + out[:, 1], out[:, 2], out[:, 3]],
-                         axis=-1)
-    return hist
+    return _combine_hist(out, nchan=nchan, s=s, f=f, b=b, bmax=bmax,
+                         double_prec=double_prec)
 
 
 def build_histograms_mxu_auto(bins, grad, hess, cnt, row_slot, *,
@@ -550,14 +556,8 @@ def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     )(row_node.astype(jnp.int32)[:, None], bins, data, tbl, member,
       feat_tbl)
 
-    out = hist.reshape(nchan, s, f, b)[..., :bmax]
-    out = jnp.transpose(out, (1, 0, 2, 3))                   # [S, C, F, B]
-    if double_prec:
-        h3 = jnp.stack([out[:, 0] + out[:, 1], out[:, 2] + out[:, 3],
-                        out[:, 4]], axis=-1)                 # [S, F, B, 3]
-    else:
-        h3 = jnp.stack([out[:, 0] + out[:, 1], out[:, 2], out[:, 3]],
-                       axis=-1)
+    h3 = _combine_hist(hist, nchan=nchan, s=s, f=f, b=b, bmax=bmax,
+                       double_prec=double_prec)
     return h3, node_out[:n, 0]
 
 

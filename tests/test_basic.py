@@ -166,6 +166,23 @@ class TestBinnedDataset:
 
 
 class TestDatasetAPI:
+    def test_create_valid_and_set_categorical(self):
+        rng = np.random.RandomState(3)
+        X = rng.randn(400, 5)
+        X[:, 2] = rng.randint(0, 8, size=400)
+        y = (X[:, 0] > 0).astype(np.float32)
+        d = lgb.Dataset(X, label=y)
+        d.set_categorical_feature([2])
+        v = d.create_valid(X[:80], label=y[:80])
+        bst = lgb.train({"objective": "binary", "verbosity": -1}, d, 5,
+                        valid_sets=[v], valid_names=["v"])
+        assert bst.num_trees() == 5
+        # after construction the categorical set is frozen
+        with pytest.raises(Exception):
+            d.set_categorical_feature([1])
+        # unchanged set is a no-op, not an error
+        d.set_categorical_feature([2])
+
     def test_lazy_construction(self):
         X = np.random.RandomState(0).randn(100, 4)
         y = np.zeros(100, np.float32)
