@@ -104,7 +104,7 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
     params = params, train_set = data, num_boost_round = as.integer(nrounds),
     valid_sets = unname(valids),
     valid_names = if (length(valids)) as.list(names(valids)) else NULL,
-    feval = eval, init_model = init_model,
+    fobj = obj, feval = eval, init_model = init_model,
     callbacks = c(list(py$record_evaluation(evals_result)), callbacks))
   attr(bst, "evals_result") <- evals_result
   class(bst) <- c("lgb.Booster", class(bst))
@@ -122,7 +122,7 @@ lgb.cv <- function(params = list(), data, nrounds = 100L, nfold = 3L,
   }
   py$cv(params = params, train_set = data,
         num_boost_round = as.integer(nrounds), nfold = as.integer(nfold),
-        stratified = stratified, feval = eval)
+        stratified = stratified, fobj = obj, feval = eval)
 }
 
 #' @export

@@ -90,9 +90,10 @@ def main():
           f"device={jax.devices()[0].device_kind}", file=sys.stderr)
     Xva, yva = make_higgs_like(40_000, N_FEATURES, seed=99)
     sc = booster.predict(Xva, raw_score=True)
-    order = np.argsort(np.argsort(sc))
+    from scipy.stats import rankdata   # midranks: tie-corrected AUC
+    r = rankdata(sc)
     npos = yva.sum()
-    auc = ((order[yva == 1] + 1).sum() - npos * (npos + 1) / 2) / \
+    auc = (r[yva == 1].sum() - npos * (npos + 1) / 2) / \
         (npos * (len(yva) - npos))
     print(f"# held-out AUC after {WARMUP_TREES + n_blocks * block_trees} "
           f"trees: {auc:.5f}", file=sys.stderr)

@@ -140,8 +140,9 @@ class GBDT:
                         "tree learners yet; ignoring cegb_* parameters")
             self._cegb_cfg = None
             self._cegb_state = None
-        # TPU kernel choice (serial learner; the sharded path keeps the
-        # portable scatter fallback for now): "mxu" = sort/gather-free
+        # TPU kernel choice (serial learner; the data-parallel sharded
+        # path picks mxu in _setup_parallel, other modes keep the
+        # portable scatter grower): "mxu" = sort/gather-free
         # one-hot-matmul growth (grower_mxu.py), "pallas" = grouped-rows
         # histogram kernel, "scatter" = pure-XLA segment adds
         backend = jax.default_backend()
@@ -299,12 +300,28 @@ class GBDT:
         else:  # feature-parallel replicates rows (docs/Features.rst:109)
             self.bins = jax.device_put(
                 self.bins, NamedSharding(self.mesh, P()))
+        # the MXU growth path composes with data-parallel sharding
+        # (per-pass histogram psum); other modes and CPU keep the
+        # portable scatter grower (same gate as the serial choice below)
+        use_mxu = (cfg.use_pallas and jax.default_backend() != "cpu" and
+                   self.comm.mode == "data" and self.bmax <= 256 and
+                   self._forced is None and self._cegb_cfg is None)
+        if cfg.feature_fraction_bynode < 1.0 or cfg.extra_trees or \
+                self._interaction_groups:
+            Log.warning("feature_fraction_bynode/extra_trees/interaction_"
+                        "constraints are not supported with distributed "
+                        "tree learners yet; ignoring them")
         self._grower = make_sharded_grower(
             self.mesh, self.comm, num_leaves=cfg.num_leaves,
             max_depth=cfg.max_depth, hp=self.hp, leafwise=False,
-            bmax=self.bmax)
-        Log.info("Distributed learner: %s-parallel over %d devices",
-                 self.comm.mode, ndev)
+            bmax=self.bmax, use_mxu=use_mxu, monotone=self._monotone,
+            mxu_kwargs=dict(
+                hist_double_prec=cfg.gpu_use_dp,
+                tail_split_cap=cfg.tail_split_cap,
+                hist_subtraction=cfg.hist_subtraction,
+                overshoot=cfg.growth_overshoot))
+        Log.info("Distributed learner: %s-parallel over %d devices%s",
+                 self.comm.mode, ndev, " (mxu)" if use_mxu else "")
 
     def _grow(self, g, h, cnt, feature_mask):
         """Dispatch serial vs sharded growth; returns (tree, row_node[:N])."""

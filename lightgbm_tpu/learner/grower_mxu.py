@@ -170,7 +170,7 @@ def _select_rows(onehot: jax.Array, table: jax.Array) -> jax.Array:
     static_argnames=("num_leaves", "max_depth", "hp", "bmax",
                      "interaction_groups", "feature_fraction_bynode",
                      "interpret", "hist_double_prec", "tail_split_cap",
-                     "hist_subtraction", "overshoot"))
+                     "hist_subtraction", "overshoot", "psum_axis"))
 def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   cnt_weight: jax.Array, feature_mask: jax.Array,
                   num_bins: jax.Array, missing_is_nan: jax.Array,
@@ -184,7 +184,8 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   hist_double_prec: bool = True,
                   tail_split_cap: int = 0,
                   hist_subtraction: bool = True,
-                  overshoot: float = 0.0
+                  overshoot: float = 0.0,
+                  psum_axis: Optional[str] = None
                   ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree; same contract as grower.grow_tree (serial mode).
 
@@ -222,9 +223,18 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     w_cat = (bmax + 31) // 32
     P_all = (s_max + 1) // 2 + 2   # pair-state capacity (subtraction)
 
-    root_g = jnp.sum(grad)
-    root_h = jnp.sum(hess)
-    root_c = jnp.sum(cnt_weight)
+    # psum_axis != None runs this grower INSIDE shard_map as the
+    # data-parallel learner: rows are sharded, per-pass histograms are
+    # all-reduced over ICI (the reference's Reduce-Scatter of histograms,
+    # data_parallel_tree_learner.cpp:184-186 — here a psum, with every
+    # shard scanning all features), and every shard takes identical
+    # split decisions, so the tree is replicated without a sync.
+    def _allred(x):
+        return jax.lax.psum(x, psum_axis) if psum_axis else x
+
+    root_g = _allred(jnp.sum(grad))
+    root_h = _allred(jnp.sum(hess))
+    root_c = _allred(jnp.sum(cnt_weight))
     root_val = leaf_output(root_g, root_h, hp.lambda_l1, hp.lambda_l2,
                            hp.max_delta_step)
     tree0 = _init_tree(m, root_g, root_h, root_c, root_val,
@@ -271,20 +281,23 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     def sweep(row_node, tbl_c, member_c, nslots):
         """Route rows through the previous pass's packed tables and build
         the frontier histograms — fused single sweep when the histogram
-        block fits VMEM, else the two-kernel fallback (wide datasets)."""
+        block fits VMEM, else the two-kernel fallback (wide datasets).
+        Under psum_axis the local histograms are all-reduced, so the
+        subtraction/scan math downstream sees global sums."""
         if fits_v2(nslots, f, bmax, hist_double_prec):
-            return fused_route_hist_mxu(
+            h, rn = fused_route_hist_mxu(
                 bins, grad, hess, cnt_weight, row_node, tbl_c, member_c,
                 feat_tbl, num_slots=nslots, bmax=bmax,
                 has_cat=hp.has_categorical,
                 double_prec=hist_double_prec, interpret=interpret)
+            return _allred(h), rn
         rn, rs = route_rows_mxu(bins, row_node, tbl_c, member_c, feat_tbl,
                                 interpret=interpret)
         h = build_histograms_mxu_auto(
             bins, grad, hess, cnt_weight, rs, num_slots=nslots, bmax=bmax,
             interpret=interpret, double_prec=hist_double_prec,
             **hist_cfg(nslots))
-        return h, rn
+        return _allred(h), rn
 
     def one_pass(s, st, pass_idx, k_cap=None, sk_next=None):
         """One growth pass at scan capacity `s` (python int). sk_next is

@@ -47,15 +47,31 @@ def replicate(mesh: Mesh, *arrays):
 
 def make_sharded_grower(mesh: Mesh, comm: CommSpec, *, num_leaves: int,
                         max_depth: int, hp, leafwise: bool, bmax: int,
-                        feature_block: int = 8):
-    """Build a shard_map'ped grow_tree with the given static config."""
+                        feature_block: int = 8, use_mxu: bool = False,
+                        mxu_kwargs: Optional[dict] = None,
+                        interpret: bool = False, monotone=None):
+    """Build a shard_map'ped grower with the given static config.
+
+    use_mxu (data-parallel only) runs the MXU grower inside shard_map
+    with per-pass histogram psum over the mesh axis — the TPU form of
+    DataParallelTreeLearner's histogram Reduce-Scatter
+    (data_parallel_tree_learner.cpp:184-186). Other modes (and the CPU
+    fallback) keep the portable scatter grower, whose collectives live
+    inside grow_tree itself."""
     axis = comm.axis
     data_spec = P(axis) if comm.mode in ("data", "voting") else P()
 
-    grower = functools.partial(
-        grow_tree, num_leaves=num_leaves, max_depth=max_depth, hp=hp,
-        leafwise=leafwise, bmax=bmax, feature_block=feature_block,
-        comm=comm)
+    if use_mxu and comm.mode == "data":
+        from ..learner.grower_mxu import grow_tree_mxu
+        grower = functools.partial(
+            grow_tree_mxu, num_leaves=num_leaves, max_depth=max_depth,
+            hp=hp, bmax=bmax, psum_axis=axis, interpret=interpret,
+            monotone=monotone, **(mxu_kwargs or {}))
+    else:
+        grower = functools.partial(
+            grow_tree, num_leaves=num_leaves, max_depth=max_depth, hp=hp,
+            leafwise=leafwise, bmax=bmax, feature_block=feature_block,
+            comm=comm, monotone=monotone)
 
     @functools.partial(
         shard_map, mesh=mesh,

@@ -85,6 +85,38 @@ class TestShardedGrower:
                                    np.asarray(tree_p.gain[:nn]),
                                    rtol=1e-4, atol=1e-5)
 
+    def test_data_parallel_mxu_matches_serial_mxu(self):
+        # the MXU grower inside shard_map (per-pass histogram psum, the
+        # reference's data-parallel Reduce-Scatter) must grow the same
+        # tree as the serial MXU grower on unsharded data
+        from lightgbm_tpu.learner.grower_mxu import grow_tree_mxu
+        args, bmax = _setup()
+        tree_s, rn_s = grow_tree_mxu(
+            *args, num_leaves=15, max_depth=-1, hp=SplitHyperParams(),
+            bmax=bmax, interpret=True, overshoot=2.0)
+        ndev = 4
+        mesh = make_mesh(ndev)
+        comm = CommSpec(axis="data", mode="data", num_devices=ndev)
+        grower = make_sharded_grower(
+            mesh, comm, num_leaves=15, max_depth=-1,
+            hp=SplitHyperParams(), leafwise=False, bmax=bmax,
+            use_mxu=True, interpret=True,
+            mxu_kwargs=dict(overshoot=2.0))
+        with mesh:
+            tree_p, rn_p = grower(*args)
+        nn = int(tree_s.num_nodes)
+        assert int(tree_p.num_nodes) == nn
+        np.testing.assert_array_equal(
+            np.asarray(tree_s.split_feature[:nn]),
+            np.asarray(tree_p.split_feature[:nn]))
+        np.testing.assert_array_equal(
+            np.asarray(tree_s.threshold_bin[:nn]),
+            np.asarray(tree_p.threshold_bin[:nn]))
+        np.testing.assert_allclose(np.asarray(tree_s.leaf_value[:nn]),
+                                   np.asarray(tree_p.leaf_value[:nn]),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(rn_s), np.asarray(rn_p))
+
     def test_voting_parallel_grows_good_tree(self):
         # voting is approximate (top-k feature aggregation); check the tree
         # splits on informative features and fits
