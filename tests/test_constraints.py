@@ -49,6 +49,23 @@ class TestMonotone:
         pred = bst.predict(rows, raw_score=True)
         assert np.all(np.diff(pred) <= 1e-9)
 
+    def test_distributed_honors_monotone(self):
+        # monotone constraints must survive tree_learner=data (they were
+        # silently dropped by the sharded-grower factory at one point)
+        import jax
+        if len(jax.devices()) < 2:
+            pytest.skip("needs multi-device")
+        r = np.random.RandomState(0)
+        n = 4096
+        X = r.randn(n, 4)
+        y = (np.sin(2 * X[:, 0]) + X[:, 1] +
+             0.1 * r.randn(n)).astype(np.float32)
+        bst = lgb.train({"objective": "regression", "verbosity": -1,
+                         "monotone_constraints": [1, 0, 0, 0],
+                         "tree_learner": "data", "num_leaves": 31},
+                        lgb.Dataset(X, label=y), 20)
+        assert _is_monotone_increasing(bst, 0, X)
+
     def test_unconstrained_differs(self):
         r = np.random.RandomState(0)
         n = 4000

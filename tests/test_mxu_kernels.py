@@ -36,6 +36,14 @@ def _data(n=4000, f=6, seed=0, with_nan=False, with_cat=False):
     return ds, jnp.asarray(p - y), jnp.asarray(p * (1 - p))
 
 
+def _mxu_args(ds, g, h):
+    """Positional args of grow_tree_mxu for a dataset + grad/hess."""
+    return (jnp.asarray(ds.bins), g, h, jnp.ones(ds.num_data, jnp.float32),
+            jnp.ones(ds.num_features, jnp.float32),
+            jnp.asarray(ds.num_bins), jnp.asarray(ds.missing_types == 2),
+            jnp.asarray(ds.is_categorical))
+
+
 def _grow_both(ds, grad, hess, num_leaves=15, **extra):
     bins = jnp.asarray(ds.bins)
     cnt = jnp.ones(ds.num_data, jnp.float32)
@@ -137,11 +145,7 @@ class TestMXUGrower:
         # parent - smaller, stale parents 2 slots) must grow the same
         # tree as building every child's histogram from rows
         ds, g, h = _data(n=6000, f=8, seed=4, with_nan=True)
-        bins = jnp.asarray(ds.bins)
-        cnt = jnp.ones(ds.num_data, jnp.float32)
-        args = (bins, g, h, cnt, jnp.ones(ds.num_features, jnp.float32),
-                jnp.asarray(ds.num_bins), jnp.asarray(ds.missing_types == 2),
-                jnp.asarray(ds.is_categorical))
+        args = _mxu_args(ds, g, h)
         kw = dict(num_leaves=31, max_depth=0,
                   hp=SplitHyperParams(min_data_in_leaf=20),
                   bmax=int(ds.num_bins.max()), interpret=True,
@@ -158,11 +162,7 @@ class TestMXUGrower:
         # precision, and the pruned tree must be self-consistent
         from lightgbm_tpu.learner.predict import predict_binned_tree
         ds, g, h = _data(n=6000, f=8, seed=6, with_nan=True)
-        bins = jnp.asarray(ds.bins)
-        cnt = jnp.ones(ds.num_data, jnp.float32)
-        args = (bins, g, h, cnt, jnp.ones(ds.num_features, jnp.float32),
-                jnp.asarray(ds.num_bins), jnp.asarray(ds.missing_types == 2),
-                jnp.asarray(ds.is_categorical))
+        args = _mxu_args(ds, g, h)
         kw = dict(num_leaves=31, max_depth=0,
                   hp=SplitHyperParams(min_data_in_leaf=20),
                   bmax=int(ds.num_bins.max()))
@@ -172,7 +172,7 @@ class TestMXUGrower:
         assert int(t_ov.num_leaves) == 31
         # row_node agrees with routing fresh rows through the pruned tree
         vals_route = predict_binned_tree(
-            t_ov, bins, jnp.asarray(ds.num_bins),
+            t_ov, args[0], jnp.asarray(ds.num_bins),
             jnp.asarray(ds.missing_types == 2))
         vals_rows = np.asarray(t_ov.leaf_value)[np.asarray(r_ov)]
         np.testing.assert_allclose(np.asarray(vals_route), vals_rows,
@@ -184,14 +184,25 @@ class TestMXUGrower:
             mismatch = np.mean(np.abs(v_lw - vals_rows) > 1e-2)
             assert mismatch < 0.02, f"row mismatch rate {mismatch}"
 
+    def test_overshoot_respects_max_depth(self):
+        # overgrow-and-prune must not let the overshoot expansion smuggle
+        # in nodes deeper than max_depth
+        ds, g, h = _data(n=6000, f=8, seed=7)
+        args = _mxu_args(ds, g, h)
+        t, _ = grow_tree_mxu(
+            *args, num_leaves=31, max_depth=3,
+            hp=SplitHyperParams(min_data_in_leaf=20),
+            bmax=int(ds.num_bins.max()), interpret=True, overshoot=2.0)
+        nn = int(t.num_nodes)
+        # this dataset fills the full depth-3 tree; == 8 also catches an
+        # under-grown stub, not just an over-deep one
+        assert int(t.num_leaves) == 8
+        assert int(np.asarray(t.depth)[:nn].max()) <= 3
+
     def test_hybrid_tail_reaches_num_leaves(self):
         # the throttled tail must still fill the leaf budget
         ds, g, h = _data(n=6000, f=8, seed=5)
-        bins = jnp.asarray(ds.bins)
-        cnt = jnp.ones(ds.num_data, jnp.float32)
-        args = (bins, g, h, cnt, jnp.ones(ds.num_features, jnp.float32),
-                jnp.asarray(ds.num_bins), jnp.asarray(ds.missing_types == 2),
-                jnp.asarray(ds.is_categorical))
+        args = _mxu_args(ds, g, h)
         t, _ = grow_tree_mxu(
             *args, num_leaves=31, max_depth=0,
             hp=SplitHyperParams(min_data_in_leaf=20),
