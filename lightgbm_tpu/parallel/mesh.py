@@ -9,13 +9,54 @@ moral analog of the reference's `Network::Init`.
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
-__all__ = ["make_mesh", "default_mesh", "init_distributed"]
+__all__ = ["make_mesh", "default_mesh", "init_distributed",
+           "provision_virtual_devices"]
+
+
+def provision_virtual_devices(n_devices: int) -> None:
+    """Force an n-device virtual CPU backend (the reference's no-cluster
+    distributed testing, _test_distributed.py:54-135, is N localhost
+    processes; ours is N virtual XLA host devices).
+
+    Must run BEFORE the first backend touch: once any jax.devices() call
+    initializes a backend, the CPU device count is latched for the process.
+    jax may be pre-imported by the harness, so env vars alone are too
+    late — the jax.config updates are what actually take effect. This
+    permanently switches the process (and, via os.environ, subprocesses)
+    to the CPU platform; it is a one-shot test/dryrun provision, not a
+    runtime mode toggle.
+    """
+    try:
+        from jax._src import xla_bridge as _xb
+        already_up = _xb.backends_are_initialized()
+    except Exception:
+        # Private API moved: attempt the config mutations below —
+        # jax_num_cpu_devices raises its own clear error post-init, and
+        # succeeds pre-init, so provisioning still works either way.
+        already_up = False
+    if already_up:
+        if len(jax.devices()) < n_devices:
+            raise RuntimeError(
+                f"need {n_devices} devices but the JAX backend was already "
+                f"initialized with {len(jax.devices())}; call "
+                f"provision_virtual_devices before any other JAX use")
+        return
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") +
+        f" --xla_force_host_platform_device_count={n_devices}").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except (AttributeError, KeyError):
+        pass  # older jax without this config: XLA_FLAGS alone works pre-init
+    jax.config.update("jax_platforms", "cpu")
 
 
 def make_mesh(num_devices: int = 0, axis: str = "data") -> Mesh:

@@ -5,18 +5,9 @@ localhost sockets (tests/distributed/_test_distributed.py). The TPU-native
 equivalent is a virtual multi-device CPU mesh — same collectives, no pod.
 """
 
-import os
+from lightgbm_tpu.parallel.mesh import provision_virtual_devices
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: harness may preset 'axon' (TPU)
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") +
-    " --xla_force_host_platform_device_count=8").strip()
-
-# jax is pre-imported at interpreter startup (TPU harness sitecustomize), so
-# the env vars above are latched too late — force the config directly.
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+provision_virtual_devices(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
