@@ -158,6 +158,11 @@ class GBDT:
             self._hist_impl = "scatter"
         Log.debug("Tree kernel path: %s (backend=%s)", self._hist_impl,
                   backend)
+        if cfg.use_quantized_grad and self._hist_impl != "mxu" and \
+                not getattr(self, "_sharded_mxu", False):
+            Log.warning("use_quantized_grad only accelerates the MXU "
+                        "growth path (active: %s); training runs "
+                        "full-precision", self._hist_impl)
         # linear trees (reference LinearTreeLearner; raw values required,
         # dataset.cpp:418-420)
         self._linear = bool(cfg.linear_tree)
@@ -306,6 +311,7 @@ class GBDT:
         use_mxu = (cfg.use_pallas and jax.default_backend() != "cpu" and
                    self.comm.mode == "data" and self.bmax <= 256 and
                    self._forced is None and self._cegb_cfg is None)
+        self._sharded_mxu = use_mxu
         if cfg.feature_fraction_bynode < 1.0 or cfg.extra_trees or \
                 self._interaction_groups:
             Log.warning("feature_fraction_bynode/extra_trees/interaction_"
@@ -319,14 +325,17 @@ class GBDT:
                 hist_double_prec=cfg.gpu_use_dp,
                 tail_split_cap=cfg.tail_split_cap,
                 hist_subtraction=cfg.hist_subtraction,
-                overshoot=cfg.growth_overshoot))
+                overshoot=cfg.growth_overshoot,
+                quantized_grad=cfg.use_quantized_grad))
         Log.info("Distributed learner: %s-parallel over %d devices%s",
                  self.comm.mode, ndev, " (mxu)" if use_mxu else "")
 
     def _grow(self, g, h, cnt, feature_mask):
         """Dispatch serial vs sharded growth; returns (tree, row_node[:N])."""
         cfg = self.config
-        needs_rng = self.hp.extra_trees or cfg.feature_fraction_bynode < 1.0
+        needs_rng = (self.hp.extra_trees or
+                     cfg.feature_fraction_bynode < 1.0 or
+                     cfg.use_quantized_grad)
         rng_key = jax.random.fold_in(
             jax.random.PRNGKey(cfg.extra_seed), self.iter_) \
             if needs_rng else None
@@ -342,7 +351,8 @@ class GBDT:
                 rng_key=rng_key, hist_double_prec=cfg.gpu_use_dp,
                 tail_split_cap=cfg.tail_split_cap,
                 hist_subtraction=cfg.hist_subtraction,
-                overshoot=cfg.growth_overshoot)
+                overshoot=cfg.growth_overshoot,
+                quantized_grad=cfg.use_quantized_grad)
         if self._grower is None:
             out = grow_tree(
                 self.bins, g, h, cnt, feature_mask, self.num_bins_d,

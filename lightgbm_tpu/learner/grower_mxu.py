@@ -35,8 +35,9 @@ import jax.numpy as jnp
 
 from .grower import _init_tree, TreeArrays
 from .histogram_mxu import (_round_up, build_histograms_mxu_auto, fits_v2,
-                            fused_route_hist_mxu, node_values_mxu,
-                            pack_route_tables, route_rows_mxu)
+                            fused_route_hist_mxu, node_sums_mxu,
+                            node_values_mxu, pack_route_tables,
+                            quantize_gradients, route_rows_mxu)
 from .split import (BestSplits, SplitHyperParams, find_best_splits,
                     leaf_output)
 
@@ -44,8 +45,8 @@ __all__ = ["grow_tree_mxu"]
 
 
 def _prune_to_best_first(tree: TreeArrays, row_node: jax.Array, *,
-                         num_leaves: int, m_grow: int,
-                         interpret: bool) -> Tuple[TreeArrays, jax.Array]:
+                         num_leaves: int, m_grow: int, interpret: bool,
+                         aux: Tuple = ()) -> Tuple:
     """Replay the reference's strict best-first growth order
     (serial_tree_learner.cpp:159-210) over an OVERGROWN tree's recorded
     split gains, keep the winning num_leaves-1 splits, and compact.
@@ -56,7 +57,10 @@ def _prune_to_best_first(tree: TreeArrays, row_node: jax.Array, *,
     overshoot expanded every node best-first would pick. Runs entirely
     on device: num_leaves-1 argmax steps over [nodes] vectors, then a
     cumsum renumbering. Rows are remapped to their nearest kept-leaf
-    ancestor, so callers see a standard (tree, row_node) pair."""
+    ancestor, so callers see a standard (tree, row_node) pair. `aux` is
+    a tuple of (array, fill) pairs compacted alongside the tree (e.g.
+    monotone constraint bounds for re-clipping recomputed leaf values);
+    the compacted arrays come back as a trailing tuple."""
     m1g = m_grow + 1
     mf = 2 * num_leaves - 1
     mf1 = mf + 1
@@ -84,25 +88,29 @@ def _prune_to_best_first(tree: TreeArrays, row_node: jax.Array, *,
     _, sel = jax.lax.fori_loop(0, num_leaves - 1, sim,
                                (avail0, jnp.zeros(m1g, bool)))
 
-    # reachability closure: a node is kept iff every ancestor was
-    # selected (depth of the kept subtree < num_leaves)
+    # reachability closure by pointer doubling: a node is kept iff every
+    # PROPER ancestor was selected (sel is root-connected by construction
+    # of the replay, so this is the whole condition). acc[i] starts as
+    # sel[parent[i]] and AND-composes up the parent chain in log2 steps
+    # instead of a num_leaves-long sequential fori_loop.
     par = jnp.clip(tree.parent, 0, m_grow)
-
-    def reach(i, kept):
-        kp = kept[par] & sel[par] & (tree.parent >= 0)
-        return kp.at[0].set(True)
-
-    kept = jax.lax.fori_loop(
-        0, num_leaves, reach, jnp.zeros(m1g, bool).at[0].set(True))
+    ids = jnp.arange(m1g, dtype=jnp.int32)
+    is_root = ids == 0  # unused scratch slots also carry parent -1
+    ptr = jnp.where(is_root, ids, par)
+    acc = jnp.where(is_root, True, sel[par])
+    for _ in range(max(1, (m1g - 1).bit_length())):
+        acc = acc & acc[ptr]
+        ptr = ptr[ptr]
+    kept = acc & (is_root | (tree.parent >= 0))
     final_leaf = kept & ~sel
 
-    # rows sit in overgrown leaves; ascend to the nearest kept leaf
-    def ascend(i, rm):
-        up = jnp.where(tree.parent[rm] >= 0, tree.parent[rm], rm)
-        return jnp.where(final_leaf[rm], rm, up)
-
-    remap = jax.lax.fori_loop(0, m_grow, ascend,
-                              jnp.arange(m1g, dtype=jnp.int32))
+    # rows sit in overgrown leaves; ascend to the nearest kept-leaf
+    # ancestor — same log2 pointer doubling (final_leaf cuts every
+    # root-to-leaf path, so the fixed point always exists)
+    nxt = jnp.where(final_leaf | is_root, ids, par)
+    for _ in range(max(1, (m1g - 1).bit_length())):
+        nxt = nxt[nxt]
+    remap = nxt
 
     # compact: renumber kept nodes densely (order-preserving, root = 0)
     new_id = jnp.cumsum(kept.astype(jnp.int32)) - 1
@@ -143,6 +151,8 @@ def _prune_to_best_first(tree: TreeArrays, row_node: jax.Array, *,
     composed = new_id[remap].astype(jnp.float32)
     row_new = node_values_mxu(row_node, composed,
                               interpret=interpret).astype(jnp.int32)
+    if aux:
+        return pruned, row_new, tuple(compact(a, fill) for a, fill in aux)
     return pruned, row_new
 
 
@@ -170,7 +180,8 @@ def _select_rows(onehot: jax.Array, table: jax.Array) -> jax.Array:
     static_argnames=("num_leaves", "max_depth", "hp", "bmax",
                      "interaction_groups", "feature_fraction_bynode",
                      "interpret", "hist_double_prec", "tail_split_cap",
-                     "hist_subtraction", "overshoot", "psum_axis"))
+                     "hist_subtraction", "overshoot", "psum_axis",
+                     "quantized_grad"))
 def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   cnt_weight: jax.Array, feature_mask: jax.Array,
                   num_bins: jax.Array, missing_is_nan: jax.Array,
@@ -185,7 +196,8 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   tail_split_cap: int = 0,
                   hist_subtraction: bool = True,
                   overshoot: float = 0.0,
-                  psum_axis: Optional[str] = None
+                  psum_axis: Optional[str] = None,
+                  quantized_grad: bool = False
                   ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree; same contract as grower.grow_tree (serial mode).
 
@@ -232,8 +244,31 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     def _allred(x):
         return jax.lax.psum(x, psum_axis) if psum_axis else x
 
-    root_g = _allred(jnp.sum(grad))
-    root_h = _allred(jnp.sum(hess))
+    # quantized_grad: stochastically-rounded integer grad/hess feed
+    # 3-channel histograms (1.67x fewer MXU flops than the 5-channel
+    # double-bf16 scheme); the final leaf values are recomputed exactly
+    # at the end, so quantization only perturbs the split SEARCH.
+    quant = quantized_grad
+    if quant:
+        qkey = rng_key if rng_key is not None else jax.random.PRNGKey(0)
+        qkey = jax.random.fold_in(qkey, 6271)
+        # decorrelate rounding noise across trees even when no per-tree
+        # key is plumbed (the sharded grower path): fold in gradient bits
+        # so each iteration's noise differs — reusing one u per row every
+        # tree would make its rounding error systematic in the ensemble
+        qkey = jax.random.fold_in(
+            qkey, jax.lax.bitcast_convert_type(jnp.sum(grad), jnp.int32))
+        h_grad, h_hess, gscale, hscale = quantize_gradients(
+            grad, hess, qkey, pmax_axis=psum_axis)
+        hist_scale = jnp.stack([gscale, hscale, jnp.float32(1.0)])
+        # hist-consistent root sums (exact integer sums x scale), so
+        # right-child = parent - left stays internally consistent
+        root_g = _allred(jnp.sum(h_grad)) * gscale
+        root_h = _allred(jnp.sum(h_hess)) * hscale
+    else:
+        h_grad, h_hess = grad, hess
+        root_g = _allred(jnp.sum(grad))
+        root_h = _allred(jnp.sum(hess))
     root_c = _allred(jnp.sum(cnt_weight))
     root_val = leaf_output(root_g, root_h, hp.lambda_l1, hp.lambda_l2,
                            hp.max_delta_step)
@@ -284,19 +319,21 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         block fits VMEM, else the two-kernel fallback (wide datasets).
         Under psum_axis the local histograms are all-reduced, so the
         subtraction/scan math downstream sees global sums."""
-        if fits_v2(nslots, f, bmax, hist_double_prec):
+        if fits_v2(nslots, f, bmax, hist_double_prec, quant):
             h, rn = fused_route_hist_mxu(
-                bins, grad, hess, cnt_weight, row_node, tbl_c, member_c,
-                feat_tbl, num_slots=nslots, bmax=bmax,
-                has_cat=hp.has_categorical,
+                bins, h_grad, h_hess, cnt_weight, row_node, tbl_c,
+                member_c, feat_tbl, num_slots=nslots, bmax=bmax,
+                has_cat=hp.has_categorical, quantized=quant,
                 double_prec=hist_double_prec, interpret=interpret)
-            return _allred(h), rn
-        rn, rs = route_rows_mxu(bins, row_node, tbl_c, member_c, feat_tbl,
-                                interpret=interpret)
-        h = build_histograms_mxu_auto(
-            bins, grad, hess, cnt_weight, rs, num_slots=nslots, bmax=bmax,
-            interpret=interpret, double_prec=hist_double_prec,
-            **hist_cfg(nslots))
+        else:
+            rn, rs = route_rows_mxu(bins, row_node, tbl_c, member_c,
+                                    feat_tbl, interpret=interpret)
+            h = build_histograms_mxu_auto(
+                bins, h_grad, h_hess, cnt_weight, rs, num_slots=nslots,
+                bmax=bmax, interpret=interpret, quantized=quant,
+                double_prec=hist_double_prec, **hist_cfg(nslots))
+        if quant:
+            h = h * hist_scale  # integer sums -> gradient units
         return _allred(h), rn
 
     def one_pass(s, st, pass_idx, k_cap=None, sk_next=None):
@@ -600,8 +637,40 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # START of a pass, so the final commits have not moved rows yet)
     row_node, _ = route_rows_mxu(bins, state[1], state[2], state[3],
                                  feat_tbl, interpret=interpret)
+    tree_out = state[0]
+    cmin, cmax = state[6], state[7]
     if over:
-        return _prune_to_best_first(state[0], row_node,
-                                    num_leaves=num_leaves, m_grow=m,
-                                    interpret=interpret)
-    return state[0], row_node
+        if quant and hp.has_monotone:
+            tree_out, row_node, (cmin, cmax) = _prune_to_best_first(
+                tree_out, row_node, num_leaves=num_leaves, m_grow=m,
+                interpret=interpret,
+                aux=((cmin, -jnp.inf), (cmax, jnp.inf)))
+        else:
+            tree_out, row_node = _prune_to_best_first(
+                tree_out, row_node, num_leaves=num_leaves, m_grow=m,
+                interpret=interpret)
+    if quant:
+        # exact leaf refit: per-leaf double-bf16 sums over the final
+        # row->leaf vector, psum'd under data-parallel; quantization then
+        # never reaches the fitted outputs (reference closed form,
+        # feature_histogram.hpp:737 CalculateSplittedLeafOutput). One
+        # caveat: with path_smooth > 0 the parent reference values are
+        # the growth-time (quantized) ones — mirroring the reference,
+        # which also smooths toward the parent's output as it stood at
+        # split time, but those carry rounding noise here.
+        nn = tree_out.leaf_value.shape[0]
+        sums = _allred(node_sums_mxu(row_node, grad, hess, cnt_weight,
+                                     num_nodes=nn, interpret=interpret))
+        pout = tree_out.leaf_value[jnp.clip(tree_out.parent, 0, nn - 1)]
+        ex_val = leaf_output(sums[:, 0], sums[:, 1], hp.lambda_l1,
+                             hp.lambda_l2, hp.max_delta_step,
+                             hp.path_smooth, sums[:, 2], pout)
+        if hp.has_monotone:
+            ex_val = jnp.clip(ex_val, cmin, cmax)
+        lf = tree_out.is_leaf
+        tree_out = tree_out._replace(
+            leaf_value=jnp.where(lf, ex_val, tree_out.leaf_value),
+            sum_grad=jnp.where(lf, sums[:, 0], tree_out.sum_grad),
+            sum_hess=jnp.where(lf, sums[:, 1], tree_out.sum_hess),
+            count=jnp.where(lf, sums[:, 2], tree_out.count))
+    return tree_out, row_node

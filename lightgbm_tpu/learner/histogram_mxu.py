@@ -42,7 +42,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["build_histograms_mxu", "build_histograms_mxu_v2",
            "build_histograms_mxu_auto", "route_rows_mxu",
-           "pack_route_tables", "node_values_mxu"]
+           "pack_route_tables", "node_values_mxu", "node_sums_mxu",
+           "quantize_gradients"]
 
 # v5e has 128 MB VMEM; the default 16 MB scoped limit starves the
 # accumulate-in-VMEM histogram output on small row counts
@@ -110,11 +111,23 @@ def _hist_kernel(nb: int, fc: int, b: int, s: int, flane: int,
     return kernel
 
 
-def _hist_channels(grad, hess, cnt, double_prec: bool):
+def _hist_channels(grad, hess, cnt, double_prec: bool,
+                   quantized: bool = False):
     """Channel matrix [N, 8] for the histogram kernels (hi/lo bf16 pairs
-    + count, or grad-hi/lo + single-bf16 hessian + count)."""
+    + count, or grad-hi/lo + single-bf16 hessian + count).
+
+    quantized=True: the caller passes stochastically-rounded INTEGER
+    gradients/hessians in [-127, 127] (quantize_gradients) — bf16-exact,
+    so each rides a single channel with no hi/lo split: 3 channels
+    instead of 5, the flop lever of quantized GBDT training adapted to
+    the MXU formulation. f32 accumulation is integer-exact to 2^24 and
+    ~1e-7-relative beyond, far inside the stochastic-rounding noise."""
     g = grad.astype(jnp.float32)
     h = hess.astype(jnp.float32)
+    if quantized:
+        chans = [g, h, cnt.astype(jnp.float32)]
+        data = jnp.stack(chans + [jnp.zeros_like(g)] * 5, axis=1)
+        return data, 3
     # reduce_precision (not a bf16 round-trip, which XLA elides under
     # --xla_allow_excess_precision) keeps the hi/lo split honest
     g_hi = jax.lax.reduce_precision(g, exponent_bits=8, mantissa_bits=7)
@@ -132,12 +145,43 @@ def _hist_channels(grad, hess, cnt, double_prec: bool):
     return data, nchan
 
 
+def quantize_gradients(grad, hess, key, *, pmax_axis=None):
+    """Stochastically-rounded integer gradients for the 3-channel
+    histogram mode: g_q = floor(g/gs + u), gs = max|g|/127 (and likewise
+    hessians). Unbiased (E[g_q]*gs = g); per-tree scales. Returns
+    (g_q, h_q, gscale, hscale) with g_q/h_q integer-valued f32.
+
+    pmax_axis: shard_map axis name for distributed training — scales must
+    agree across shards so every rank bins identical integers."""
+    g = grad.astype(jnp.float32)
+    h = hess.astype(jnp.float32)
+    gmax = jnp.max(jnp.abs(g))
+    # abs: custom objectives may hand back negative hessians; scaling by
+    # max|h| keeps h_q inside the bf16-exact [-127, 127] band either way
+    hmax = jnp.max(jnp.abs(h))
+    if pmax_axis:
+        gmax = jax.lax.pmax(gmax, pmax_axis)
+        hmax = jax.lax.pmax(hmax, pmax_axis)
+    gscale = jnp.maximum(gmax, 1e-30) / 127.0
+    hscale = jnp.maximum(hmax, 1e-30) / 127.0
+    ku, kv = jax.random.split(key)
+    ug = jax.random.uniform(ku, g.shape)
+    uh = jax.random.uniform(kv, h.shape)
+    # clip: f32 rounding at the band edge (127 + u -> 128.0) can escape
+    # the documented [-127, 127] contract a few times per billion rows
+    g_q = jnp.clip(jnp.floor(g / gscale + ug), -127.0, 127.0)
+    h_q = jnp.clip(jnp.floor(h / hscale + uh), -127.0, 127.0)
+    return g_q, h_q, gscale, hscale
+
+
 def _combine_hist(out, *, nchan: int, s: int, f: int, b: int, bmax: int,
                   double_prec: bool) -> jax.Array:
     """Kernel output [*, nchan*s, f*b] -> [S, F, bmax, 3] with the hi/lo
     channel recombination (shared postlude of the v2/fused kernels)."""
     out = out.reshape(nchan, s, f, b)[..., :bmax]
     out = jnp.transpose(out, (1, 0, 2, 3))                   # [S, C, F, B]
+    if nchan == 3:  # quantized: integer g/h sums ride single channels
+        return jnp.stack([out[:, 0], out[:, 1], out[:, 2]], axis=-1)
     if double_prec:
         return jnp.stack([out[:, 0] + out[:, 1], out[:, 2] + out[:, 3],
                           out[:, 4]], axis=-1)               # [S, F, B, 3]
@@ -247,12 +291,13 @@ def _hist_kernel_v2(nb: int, f: int, b: int, s: int,
 
 @functools.partial(
     jax.jit, static_argnames=("num_slots", "bmax", "row_block", "fchunk",
-                              "interpret", "use_f32", "double_prec"))
+                              "interpret", "use_f32", "double_prec",
+                              "quantized"))
 def build_histograms_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                          cnt: jax.Array, row_slot: jax.Array, *,
                          num_slots: int, bmax: int, row_block: int = 1024,
                          fchunk: int = 4, use_f32: bool = False,
-                         double_prec: bool = True,
+                         double_prec: bool = True, quantized: bool = False,
                          interpret: bool = False) -> jax.Array:
     """Per-slot histograms without sorting or gathering.
 
@@ -287,7 +332,7 @@ def build_histograms_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     if npad:
         slot = jnp.pad(slot, (0, npad), constant_values=-1)
 
-    data, nchan = _hist_channels(grad, hess, cnt, double_prec)
+    data, nchan = _hist_channels(grad, hess, cnt, double_prec, quantized)
     if npad:
         data = jnp.pad(data, ((0, npad), (0, 0)))
 
@@ -319,7 +364,9 @@ def build_histograms_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     out = out.reshape(nchunks, nchan, s, fc, b)
     out = jnp.transpose(out, (2, 1, 0, 3, 4)).reshape(s, nchan, fpad, b)
     out = out[:, :, :f, :bmax]
-    if double_prec:
+    if nchan == 3:
+        hist = jnp.stack([out[:, 0], out[:, 1], out[:, 2]], axis=-1)
+    elif double_prec:
         hist = jnp.stack([out[:, 0] + out[:, 1], out[:, 2] + out[:, 3],
                           out[:, 4]], axis=-1)               # [S, F, B, 3]
     else:
@@ -334,24 +381,26 @@ _V2_OUT_BYTES = 48 * 1024 * 1024
 
 
 def fits_v2(num_slots: int, num_features: int, bmax: int,
-            double_prec: bool = True) -> bool:
+            double_prec: bool = True, quantized: bool = False) -> bool:
     """Whether the extraction-free v2/fused kernels' resident histogram
     block fits the VMEM budget for this shape (single owner of the
     predicate — the grower and the auto dispatcher must agree)."""
     b = ((bmax + 127) // 128) * 128
-    nchan = 5 if double_prec else 4
+    nchan = 3 if quantized else (5 if double_prec else 4)
     return nchan * num_slots * num_features * b * 4 <= _V2_OUT_BYTES
 
 
 @functools.partial(
     jax.jit, static_argnames=("num_slots", "bmax", "row_block",
-                              "interpret", "use_f32", "double_prec"))
+                              "interpret", "use_f32", "double_prec",
+                              "quantized"))
 def build_histograms_mxu_v2(bins: jax.Array, grad: jax.Array,
                             hess: jax.Array, cnt: jax.Array,
                             row_slot: jax.Array, *, num_slots: int,
                             bmax: int, row_block: int = 4096,
                             use_f32: bool = False,
                             double_prec: bool = True,
+                            quantized: bool = False,
                             interpret: bool = False) -> jax.Array:
     """Extraction-free variant of build_histograms_mxu (same contract):
     one grid pass over rows, per-feature static lane slices instead of
@@ -373,7 +422,7 @@ def build_histograms_mxu_v2(bins: jax.Array, grad: jax.Array,
         .astype(jnp.int32)
     if npad:
         slot = jnp.pad(slot, (0, npad), constant_values=-1)
-    data, nchan = _hist_channels(grad, hess, cnt, double_prec)
+    data, nchan = _hist_channels(grad, hess, cnt, double_prec, quantized)
     if npad:
         data = jnp.pad(data, ((0, npad), (0, 0)))
 
@@ -406,17 +455,19 @@ def build_histograms_mxu_v2(bins: jax.Array, grad: jax.Array,
 
 def build_histograms_mxu_auto(bins, grad, hess, cnt, row_slot, *,
                               num_slots, bmax, double_prec=True,
-                              interpret=False, **v1_cfg):
+                              quantized=False, interpret=False, **v1_cfg):
     """v2 kernel when its per-feature output block fits VMEM, else the
     chunked v1 kernel (wide-feature datasets)."""
     f = bins.shape[1]
-    if fits_v2(num_slots, f, bmax, double_prec):
+    if fits_v2(num_slots, f, bmax, double_prec, quantized):
         return build_histograms_mxu_v2(
             bins, grad, hess, cnt, row_slot, num_slots=num_slots,
-            bmax=bmax, double_prec=double_prec, interpret=interpret)
+            bmax=bmax, double_prec=double_prec, quantized=quantized,
+            interpret=interpret)
     return build_histograms_mxu(
         bins, grad, hess, cnt, row_slot, num_slots=num_slots, bmax=bmax,
-        double_prec=double_prec, interpret=interpret, **v1_cfg)
+        double_prec=double_prec, quantized=quantized, interpret=interpret,
+        **v1_cfg)
 
 
 def _fused_kernel(nb: int, f: int, flane: int, b: int, s: int, m: int,
@@ -440,9 +491,14 @@ def _fused_kernel(nb: int, f: int, flane: int, b: int, s: int, m: int,
 
         node = node_ref[:]                                   # [nb, 1] i32
         iota_m = jax.lax.broadcasted_iota(jnp.int32, (nb, m), 1)
-        node_oh = (node == iota_m).astype(jnp.float32)       # [nb, M]
+        # bf16 operands: the node table was designed around base-256
+        # digits (every entry <= 256, bf16-exact), and one-hot rows make
+        # the f32 accumulation a pure selection — bit-exact at 1/4 the
+        # MXU passes of an f32 dot
+        node_oh = (node == iota_m).astype(jnp.bfloat16)      # [nb, M]
+        tbl_bf = tbl_ref[:].astype(jnp.bfloat16)
         gath = jax.lax.dot_general(
-            node_oh, tbl_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
+            node_oh, tbl_bf, dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)              # [nb, K]
 
         def col(c):
@@ -458,7 +514,7 @@ def _fused_kernel(nb: int, f: int, flane: int, b: int, s: int, m: int,
         @pl.when(block_has_split)
         def _():
             memb = jax.lax.dot_general(
-                node_oh, member_ref[:],
+                node_oh, member_ref[:].astype(jnp.bfloat16),
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32) if has_cat else None
             new_node_f = _route_decide(
@@ -472,9 +528,9 @@ def _fused_kernel(nb: int, f: int, flane: int, b: int, s: int, m: int,
         # nodes carry slot -1 in the table except the initial root pass,
         # so this also covers blocks the route skipped.
         new_node = node_out_ref[:]                           # [nb, 1] i32
-        new_oh = (new_node == iota_m).astype(jnp.float32)
+        new_oh = (new_node == iota_m).astype(jnp.bfloat16)
         qr = jax.lax.dot_general(
-            new_oh, tbl_ref[:, _COL_SLOT_Q:_COL_SLOT_R + 1],
+            new_oh, tbl_bf[:, _COL_SLOT_Q:_COL_SLOT_R + 1],
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)              # [nb, 2]
         slot = (qr[:, 0:1] * 256.0 + qr[:, 1:2]).astype(jnp.int32)
@@ -492,13 +548,13 @@ def _fused_kernel(nb: int, f: int, flane: int, b: int, s: int, m: int,
 
 @functools.partial(
     jax.jit, static_argnames=("num_slots", "bmax", "row_block", "has_cat",
-                              "double_prec", "interpret"))
+                              "double_prec", "quantized", "interpret"))
 def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                          cnt: jax.Array, row_node: jax.Array,
                          tbl: jax.Array, member: jax.Array,
                          feat_tbl: jax.Array, *, num_slots: int, bmax: int,
                          row_block: int = 4096, has_cat: bool = True,
-                         double_prec: bool = True,
+                         double_prec: bool = True, quantized: bool = False,
                          interpret: bool = False):
     """One sweep: route rows through the previous pass's packed split
     tables (pack_route_tables) AND build the per-slot histograms of the
@@ -526,7 +582,7 @@ def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     if feat_tbl.shape[0] != flane:
         feat_tbl = jnp.pad(feat_tbl,
                            ((0, flane - feat_tbl.shape[0]), (0, 0)))
-    data, nchan = _hist_channels(grad, hess, cnt, double_prec)
+    data, nchan = _hist_channels(grad, hess, cnt, double_prec, quantized)
     if npad:
         data = jnp.pad(data, ((0, npad), (0, 0)))
 
@@ -629,15 +685,17 @@ def _route_kernel(nb: int, f: int, m: int, bpad: int,
                out_ref):
         node = node_ref[:]                                   # [nb, 1] i32
         iota_m = jax.lax.broadcasted_iota(jnp.int32, (nb, m), 1)
-        node_oh = (node == iota_m).astype(jnp.float32)       # [nb, M]
+        # bf16 operands are exact here: table entries <= 256 by design
+        node_oh = (node == iota_m).astype(jnp.bfloat16)      # [nb, M]
+        tbl_bf = tbl_ref[:].astype(jnp.bfloat16)
         gath = jax.lax.dot_general(
-            node_oh, tbl_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
+            node_oh, tbl_bf, dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)              # [nb, K]
 
         def slot_of(node_f):
-            oh = (node_f.astype(jnp.int32) == iota_m).astype(jnp.float32)
+            oh = (node_f.astype(jnp.int32) == iota_m).astype(jnp.bfloat16)
             qr = jax.lax.dot_general(
-                oh, tbl_ref[:, _COL_SLOT_Q:_COL_SLOT_R + 1],
+                oh, tbl_bf[:, _COL_SLOT_Q:_COL_SLOT_R + 1],
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)          # [nb, 2]
             return qr[:, 0:1] * 256.0 + qr[:, 1:2]
@@ -655,7 +713,7 @@ def _route_kernel(nb: int, f: int, m: int, bpad: int,
         @pl.when(block_has_split)
         def _():
             memb = jax.lax.dot_general(
-                node_oh, member_ref[:],
+                node_oh, member_ref[:].astype(jnp.bfloat16),
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32) if has_cat else None
             new_node_f = _route_decide(
@@ -704,6 +762,66 @@ def route_rows_mxu(bins: jax.Array, row_node: jax.Array, tbl: jax.Array,
         **({} if interpret else {"compiler_params": _COMPILER_PARAMS}),
     )(row_node.astype(jnp.int32)[:, None], bins, tbl, member, feat_tbl)
     return out[:n, 0], out[:n, 1]
+
+
+# ---------------------------------------------------------------------------
+# exact per-node sums (leaf-value recomputation)
+# ---------------------------------------------------------------------------
+
+def _node_sums_kernel(nb: int, m: int):
+    def kernel(node_ref, data_ref, out_ref):
+        ri = pl.program_id(0)
+
+        @pl.when(ri == 0)
+        def _():
+            out_ref[0] = jnp.zeros_like(out_ref[0])
+
+        node = node_ref[:]                                   # [nb, 1] i32
+        iota_m = jax.lax.broadcasted_iota(jnp.int32, (nb, m), 1)
+        oh = (node == iota_m).astype(jnp.bfloat16)           # [nb, M]
+        data = data_ref[:].astype(jnp.bfloat16)              # [nb, 8]
+        out_ref[0] += jax.lax.dot_general(
+            oh, data, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [M, 8]
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("num_nodes", "row_block",
+                                             "interpret"))
+def node_sums_mxu(row_node: jax.Array, grad: jax.Array, hess: jax.Array,
+                  cnt: jax.Array, *, num_nodes: int, row_block: int = 4096,
+                  interpret: bool = False) -> jax.Array:
+    """Exact per-node (grad, hess, count) sums from the row->node vector —
+    the double-bf16 one-hot contraction, gather-free. Used to recompute
+    leaf values exactly after quantized growth (quantization then only
+    ever perturbs the split SEARCH, never the fitted outputs; the
+    reference's leaf output closed form gbdt.cpp:412 stays exact).
+    Returns [num_nodes, 3] f32. Rows with node < 0 or >= num_nodes are
+    ignored."""
+    n = row_node.shape[0]
+    m = _round_up(num_nodes, 128)
+    nb = row_block
+    data, _ = _hist_channels(grad, hess, cnt, double_prec=True)
+    npad = (-n) % nb
+    node = row_node.astype(jnp.int32)
+    if npad:
+        node = jnp.pad(node, (0, npad), constant_values=-1)
+        data = jnp.pad(data, ((0, npad), (0, 0)))
+    out = pl.pallas_call(
+        _node_sums_kernel(nb, m),
+        grid=((n + npad) // nb,),
+        in_specs=[
+            pl.BlockSpec((nb, 1), lambda ri: (ri, 0)),
+            pl.BlockSpec((nb, 8), lambda ri: (ri, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, m, 8), lambda ri: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, m, 8), jnp.float32),
+        interpret=interpret,
+        **({} if interpret else {"compiler_params": _COMPILER_PARAMS}),
+    )(node[:, None], data)[0, :num_nodes]
+    return jnp.stack([out[:, 0] + out[:, 1], out[:, 2] + out[:, 3],
+                      out[:, 4]], axis=-1)                   # [M, 3]
 
 
 # ---------------------------------------------------------------------------

@@ -208,3 +208,112 @@ class TestMXUGrower:
             hp=SplitHyperParams(min_data_in_leaf=20),
             bmax=int(ds.num_bins.max()), interpret=True, tail_split_cap=2)
         assert int(t.num_leaves) == 31
+
+
+class TestQuantizedGrad:
+    """use_quantized_grad: 3-channel integer histograms + exact leaf refit
+    (split search may differ from exact histograms on near-tie gains; the
+    fitted leaf values must not)."""
+
+    def test_quantized_histogram_integer_sums(self):
+        from lightgbm_tpu.learner.histogram_mxu import (
+            build_histograms_mxu_v2, quantize_gradients)
+        ds, g, h = _data(n=3000)
+        bins = jnp.asarray(ds.bins)
+        cnt = jnp.ones(ds.num_data, jnp.float32)
+        slot = jnp.asarray(
+            np.random.RandomState(3).randint(-1, 8, size=ds.num_data)
+            .astype(np.int32))
+        bmax = int(ds.num_bins.max())
+        gq, hq, gs, hs = quantize_gradients(g, h, jax.random.PRNGKey(0))
+        hm = build_histograms_mxu_v2(bins, gq, hq, cnt, slot, num_slots=8,
+                                     bmax=bmax, quantized=True,
+                                     interpret=True)
+        # per-slot integer sums must match an exact host scatter of gq/hq
+        gq_h = np.asarray(gq)
+        hq_h = np.asarray(hq)
+        sl = np.asarray(slot)
+        bn = np.asarray(ds.bins)
+        want = np.zeros((8, ds.num_features, bmax, 3))
+        for r in range(ds.num_data):
+            if sl[r] < 0:
+                continue
+            for f in range(ds.num_features):
+                want[sl[r], f, bn[r, f], 0] += gq_h[r]
+                want[sl[r], f, bn[r, f], 1] += hq_h[r]
+                want[sl[r], f, bn[r, f], 2] += 1
+        np.testing.assert_allclose(np.asarray(hm), want, atol=1e-3)
+
+    def test_quantization_unbiased_and_in_range(self):
+        from lightgbm_tpu.learner.histogram_mxu import quantize_gradients
+        rng = np.random.RandomState(0)
+        g = jnp.asarray(rng.randn(20000).astype(np.float32))
+        h = jnp.asarray(rng.rand(20000).astype(np.float32))
+        gq, hq, gs, hs = quantize_gradients(g, h, jax.random.PRNGKey(1))
+        gq_h, hq_h = np.asarray(gq), np.asarray(hq)
+        assert np.all(gq_h == np.round(gq_h))
+        assert gq_h.min() >= -127 and gq_h.max() <= 127
+        assert hq_h.min() >= 0 and hq_h.max() <= 127
+        # unbiased: mean reconstruction error ~0 vs per-element scale
+        err = gq_h * float(gs) - np.asarray(g)
+        assert abs(err.mean()) < float(gs) * 0.02
+
+    def test_node_sums_exact(self):
+        from lightgbm_tpu.learner.histogram_mxu import node_sums_mxu
+        ds, g, h = _data(n=5000)
+        cnt = jnp.ones(ds.num_data, jnp.float32)
+        node = jnp.asarray(
+            np.random.RandomState(4).randint(0, 29, size=ds.num_data)
+            .astype(np.int32))
+        got = np.asarray(node_sums_mxu(node, g, h, cnt, num_nodes=29,
+                                       interpret=True))
+        nh = np.asarray(node)
+        gh, hh = np.asarray(g, np.float64), np.asarray(h, np.float64)
+        for j in range(29):
+            m = nh == j
+            np.testing.assert_allclose(got[j, 0], gh[m].sum(), rtol=2e-5,
+                                       atol=1e-5)
+            np.testing.assert_allclose(got[j, 1], hh[m].sum(), rtol=2e-5,
+                                       atol=1e-5)
+            np.testing.assert_allclose(got[j, 2], m.sum(), rtol=0,
+                                       atol=0.01)
+
+    def test_quantized_grower_leaf_values_exact(self):
+        # the tree may pick slightly different near-tie splits; whatever
+        # tree it grows, leaf values must equal the exact refit over the
+        # final row partition (incl. after overgrow-and-prune remapping)
+        ds, g, h = _data(n=4000, seed=6)
+        args = _mxu_args(ds, g, h)
+        hp = SplitHyperParams(min_data_in_leaf=20)
+        t, rn = grow_tree_mxu(
+            *args, num_leaves=15, max_depth=0, hp=hp,
+            bmax=int(ds.num_bins.max()), interpret=True, overshoot=2.0,
+            quantized_grad=True, rng_key=jax.random.PRNGKey(2))
+        assert int(t.num_leaves) == 15
+        rn_h = np.asarray(rn)
+        gh = np.asarray(g, np.float64)
+        hh = np.asarray(h, np.float64)
+        lv = np.asarray(t.leaf_value)
+        for j in np.where(np.asarray(t.is_leaf))[0]:
+            m = rn_h == j
+            if not m.any():
+                continue
+            want = -gh[m].sum() / (hh[m].sum() + hp.lambda_l2)
+            np.testing.assert_allclose(lv[j], want, rtol=1e-3, atol=1e-4)
+
+    def test_quantized_grower_close_to_exact_tree(self):
+        # on a well-separated dataset the quantized search picks the same
+        # splits as the exact one
+        ds, g, h = _data(n=4000, seed=7)
+        args = _mxu_args(ds, g, h)
+        kw = dict(num_leaves=15, max_depth=0,
+                  hp=SplitHyperParams(min_data_in_leaf=20),
+                  bmax=int(ds.num_bins.max()), interpret=True)
+        t0, _ = grow_tree_mxu(*args, **kw)
+        t1, _ = grow_tree_mxu(*args, **kw, quantized_grad=True,
+                              rng_key=jax.random.PRNGKey(3))
+        nn = int(t0.num_nodes)
+        assert int(t1.num_nodes) == nn
+        same = (np.asarray(t0.split_feature)[:nn] ==
+                np.asarray(t1.split_feature)[:nn]).mean()
+        assert same >= 0.9
