@@ -22,7 +22,7 @@ N_FEATURES = 28
 NUM_LEAVES = 255
 MAX_BIN = 255
 WARMUP_TREES = 5
-BENCH_TREES = int(os.environ.get("BENCH_TREES", 30))
+BENCH_TREES = int(os.environ.get("BENCH_TREES", 100))
 BLOCK_TREES = int(os.environ.get("BENCH_BLOCK_TREES", 10))
 BASELINE_TREES_PER_SEC = 500.0 / 130.094  # reference CPU Higgs headline
 
@@ -73,9 +73,15 @@ def main():
             booster.update()
         float(np.asarray(booster.gbdt.train_score[:1])[0])
         block_times.append(time.time() - t1)
-    dt = min(block_times)
+    rates = sorted(block_trees / b for b in block_times)
+    best_rate = rates[-1]
+    median_rate = rates[len(rates) // 2] if len(rates) % 2 else \
+        0.5 * (rates[len(rates) // 2 - 1] + rates[len(rates) // 2])
 
-    trees_per_sec = block_trees / dt
+    # the tunnel-oscillation rationale for best-block stands (docs/
+    # PerfNotes.md), but the headline reports the MEDIAN so steady-state
+    # is not overstated; best is in the detail line
+    trees_per_sec = median_rate
     result = {
         "metric": "higgs1m_trees_per_sec",
         "value": round(trees_per_sec, 3),
@@ -86,15 +92,13 @@ def main():
     print(json.dumps(result))
     blocks = ", ".join(f"{block_trees / b:.2f}" for b in block_times)
     print(f"# bench detail: {n_blocks} blocks x {block_trees} trees, "
-          f"trees/sec per block: [{blocks}], binning {bin_time:.1f}s, "
+          f"median {median_rate:.2f} best {best_rate:.2f} trees/sec, "
+          f"per block: [{blocks}], binning {bin_time:.1f}s, "
           f"device={jax.devices()[0].device_kind}", file=sys.stderr)
     Xva, yva = make_higgs_like(40_000, N_FEATURES, seed=99)
     sc = booster.predict(Xva, raw_score=True)
-    from scipy.stats import rankdata   # midranks: tie-corrected AUC
-    r = rankdata(sc)
-    npos = yva.sum()
-    auc = (r[yva == 1].sum() - npos * (npos + 1) / 2) / \
-        (npos * (len(yva) - npos))
+    from lightgbm_tpu.metrics import AUCMetric  # tie-corrected, no scipy
+    auc = AUCMetric._auc_fast(sc, yva > 0, np.ones_like(yva))
     print(f"# held-out AUC after {WARMUP_TREES + n_blocks * block_trees} "
           f"trees: {auc:.5f}", file=sys.stderr)
     print("# note: vs_baseline uses the reference's published 10.5M-row "

@@ -130,6 +130,13 @@ class GBDT:
             monotone_penalty=cfg.monotone_penalty,
             extra_trees=cfg.extra_trees,
             has_categorical=bool(np.any(ds.is_categorical)))
+        # intermediate/advanced monotone methods need leaf-wise growth
+        # with per-pass bound recomputation — portable grower only
+        self._mono_nonbasic = (
+            cfg.monotone_constraints is not None and
+            cfg.monotone_constraints_method != "basic")
+        self._mono_method = (cfg.monotone_constraints_method
+                             if self._mono_nonbasic else "basic")
         self._setup_parallel(cfg)
         if self._forced is not None and self._grower is not None:
             Log.warning("forced splits are not supported with distributed "
@@ -150,7 +157,7 @@ class GBDT:
             # the mxu kernels carry bin values through bf16 matmul
             # operands, exact only for max_bin <= 256
             if self._forced is None and self._cegb_cfg is None and \
-                    self.bmax <= 256:
+                    self.bmax <= 256 and not self._mono_nonbasic:
                 self._hist_impl = "mxu"
             else:
                 self._hist_impl = "pallas"
@@ -310,7 +317,8 @@ class GBDT:
         # portable scatter grower (same gate as the serial choice below)
         use_mxu = (cfg.use_pallas and jax.default_backend() != "cpu" and
                    self.comm.mode == "data" and self.bmax <= 256 and
-                   self._forced is None and self._cegb_cfg is None)
+                   self._forced is None and self._cegb_cfg is None and
+                   not self._mono_nonbasic)
         self._sharded_mxu = use_mxu
         if cfg.feature_fraction_bynode < 1.0 or cfg.extra_trees or \
                 self._interaction_groups:
@@ -319,8 +327,10 @@ class GBDT:
                         "tree learners yet; ignoring them")
         self._grower = make_sharded_grower(
             self.mesh, self.comm, num_leaves=cfg.num_leaves,
-            max_depth=cfg.max_depth, hp=self.hp, leafwise=False,
+            max_depth=cfg.max_depth, hp=self.hp,
+            leafwise=self._mono_nonbasic,
             bmax=self.bmax, use_mxu=use_mxu, monotone=self._monotone,
+            monotone_method=self._mono_method,
             mxu_kwargs=dict(
                 hist_double_prec=cfg.gpu_use_dp,
                 tail_split_cap=cfg.tail_split_cap,
@@ -359,13 +369,14 @@ class GBDT:
                 self.missing_is_nan_d, self.is_cat_d,
                 num_leaves=cfg.num_leaves,
                 max_depth=cfg.max_depth, hp=self.hp,
-                leafwise=False, bmax=self.bmax,
+                leafwise=self._mono_nonbasic, bmax=self.bmax,
                 monotone=self._monotone,
                 interaction_groups=self._interaction_groups,
                 feature_fraction_bynode=cfg.feature_fraction_bynode,
                 rng_key=rng_key, hist_impl=self._hist_impl,
                 forced=self._forced, cegb_cfg=self._cegb_cfg,
-                cegb_state=self._cegb_state)
+                cegb_state=self._cegb_state,
+                monotone_method=self._mono_method)
             if self._cegb_cfg is not None:
                 tree, row_node, (fu, rfu) = out
                 # feature-used flags persist across the whole model

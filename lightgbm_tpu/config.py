@@ -362,9 +362,30 @@ class Config:
             full = 1 << min(self.max_depth, 30)
             if self.num_leaves > full:
                 self.num_leaves = full
-        if self.is_parallel and self.monotone_constraints is not None and \
-                self.monotone_constraints_method == "intermediate":
-            self.monotone_constraints_method = "basic"
+        requested_mc_method = self.monotone_constraints_method
+        if self.monotone_constraints is not None and \
+                requested_mc_method in ("intermediate", "advanced"):
+            # the reference downgrades these for ALL distributed modes
+            # (config.cpp:381-384: local nodes lack full histograms);
+            # here data/feature-parallel scans see globally merged
+            # histograms, so only voting (partial aggregation) cannot
+            # support the rescan
+            if self.tree_learner == "voting":
+                from .utils.log import Log
+                Log.warning(
+                    "Cannot use %r monotone constraints with the voting "
+                    "tree learner, auto set to \"basic\" method.",
+                    requested_mc_method)
+                self.monotone_constraints_method = "basic"
+            if self.feature_fraction_bynode != 1.0:
+                # reference config.cpp:386-390: by-node sampling would
+                # resample on every recompute-triggered re-find
+                from .utils.log import Log
+                Log.warning(
+                    "Cannot use %r monotone constraints with "
+                    "feature_fraction_bynode != 1, auto set to \"basic\" "
+                    "method.", requested_mc_method)
+                self.monotone_constraints_method = "basic"
         if self.linear_tree and self.boosting == "goss":
             raise ValueError("linear_tree is not supported with goss boosting")
         if self.linear_tree:

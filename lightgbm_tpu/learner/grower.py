@@ -34,6 +34,7 @@ import jax.numpy as jnp
 
 from ..parallel.comm import CommSpec
 from .histogram import build_histograms
+from .monotone import recompute_bounds
 from .split import (BestSplits, SplitHyperParams, _split_gain,
                     find_best_splits, leaf_gain, leaf_output)
 
@@ -89,6 +90,8 @@ class _GrowState(NamedTuple):
     cons_min: jax.Array        # [M+1] monotone lower bound per node
     cons_max: jax.Array        # [M+1] monotone upper bound per node
     path_mask: jax.Array       # [M+1, F] features used on root path (or [1,1])
+    hist_cache: jax.Array      # [M+1, F, B, 3] per-node hists (intermediate/
+                               # advanced monotone rescan) or [1] dummy
     pass_idx: jax.Array
     done: jax.Array
 
@@ -136,7 +139,7 @@ def _merge_gathered_best(gathered: BestSplits) -> BestSplits:
     static_argnames=("num_leaves", "max_depth", "hp", "leafwise", "bmax",
                      "feature_block", "max_passes", "comm",
                      "interaction_groups", "feature_fraction_bynode",
-                     "hist_impl", "cegb_cfg"))
+                     "hist_impl", "cegb_cfg", "monotone_method"))
 def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               cnt_weight: jax.Array, feature_mask: jax.Array,
               num_bins: jax.Array, missing_is_nan: jax.Array,
@@ -153,7 +156,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                      jax.Array]] = None,
               cegb_cfg: Optional[CegbParams] = None,
               cegb_state: Optional[Tuple[jax.Array, jax.Array, jax.Array]]
-              = None):
+              = None, monotone_method: str = "basic"):
     """Grow one tree. grad/hess must already include bagging/objective
     weights (zeros for out-of-bag rows); `cnt_weight` is 1.0 for in-bag rows
     and 0.0 otherwise so min_data_in_leaf counts sampled rows only.
@@ -167,6 +170,22 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     s = num_leaves + 1                 # frontier slots (2k children <= S)
     if max_passes <= 0:
         max_passes = num_leaves - 1
+    # intermediate/advanced monotone methods: whole-tree bound recompute
+    # + all-leaves rescan from a histogram cache each iteration (the
+    # vectorized equivalent of the reference's leaves_to_update refresh,
+    # monotone_constraints.hpp:558-587). Bounds recomputed at pass start
+    # are only sound for one split per pass — leaf-wise is required.
+    mono_rescan = monotone_method != "basic" and monotone is not None
+    if mono_rescan:
+        if not leafwise:
+            raise ValueError(
+                "monotone_constraints_method=%r requires leaf-wise growth"
+                % monotone_method)
+        if comm is not None and comm.mode == "voting":
+            raise ValueError(
+                "monotone_constraints_method=%r is not supported with the "
+                "voting tree learner (partial histograms cannot be "
+                "cached)" % monotone_method)
     k_top = num_leaves - 1             # static top-k size
     rows_sharded = comm is not None and comm.mode in ("data", "voting")
     if comm is not None and comm.mode == "feature":
@@ -261,6 +280,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         cons_min=jnp.full(m + 1, -jnp.inf, jnp.float32),
         cons_max=jnp.full(m + 1, jnp.inf, jnp.float32),
         path_mask=path_mask0,
+        hist_cache=(jnp.zeros((m + 1, f, bmax, 3), jnp.float32)
+                    if mono_rescan else jnp.zeros(1, jnp.float32)),
         pass_idx=jnp.asarray(0, jnp.int32),
         done=jnp.asarray(False))
 
@@ -282,13 +303,32 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                     feature_block=feature_block)
         # ---- 2. best-split scan per slot (with collectives if parallel) ----
         sn = st.slot_nodes                                  # [S] (M=dummy)
+        hist_cache = st.hist_cache
+        if mono_rescan:
+            # cache the (globally merged) frontier histograms per node,
+            # then rescan EVERY node with freshly recomputed bounds — the
+            # vectorized form of the reference's refresh-and-refind of
+            # affected leaves (monotone_constraints.hpp:558 Update ->
+            # leaves_to_update -> serial_tree_learner re-find)
+            gh = jax.lax.psum(hist, comm.axis) if (
+                comm is not None and comm.mode == "data") else hist
+            hist_cache = hist_cache.at[sn].set(gh)
+            sn = jnp.arange(m + 1, dtype=jnp.int32)
+            hist = hist_cache
+            s_scan = m + 1
+        else:
+            s_scan = s
 
         # per-slot feature mask: bytree fraction x bynode sample x
         # interaction-allowed set (reference ColSampler, col_sampler.hpp:20)
-        slot_fmask = jnp.broadcast_to(feature_mask[None, :], (s, f))
+        slot_fmask = jnp.broadcast_to(feature_mask[None, :], (s_scan, f))
         if use_bynode:
-            ku = jax.random.fold_in(rng_key, st.pass_idx)
-            u = jax.random.uniform(ku, (s, f))
+            # rescan slots ARE nodes: a fixed key keeps each node's
+            # by-node feature sample stable across re-scans (the
+            # reference samples once per leaf)
+            ku = jax.random.fold_in(rng_key,
+                                    1 if mono_rescan else st.pass_idx)
+            u = jax.random.uniform(ku, (s_scan, f))
             u = jnp.where(feature_mask[None, :] > 0, u, jnp.inf)
             kth = jnp.sort(u, axis=1)[:, k_bynode - 1][:, None]
             slot_fmask = slot_fmask * (u <= kth)
@@ -303,24 +343,30 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         rand_bins = None
         if hp.extra_trees and rng_key is not None:
             kr = jax.random.fold_in(jax.random.fold_in(rng_key, 7919),
-                                    st.pass_idx)
-            rand_bins = jax.random.randint(kr, (s, f), 0, bmax)
+                                    1 if mono_rescan else st.pass_idx)
+            rand_bins = jax.random.randint(kr, (s_scan, f), 0, bmax)
         if use_cegb:
             gp = cegb_cfg.tradeoff * cegb_cfg.penalty_split * \
-                tree.count[sn][:, None] * jnp.ones((s, f), jnp.float32)
+                tree.count[sn][:, None] * jnp.ones((s_scan, f), jnp.float32)
             if cegb_cfg.has_coupled:
                 gp += cegb_cfg.tradeoff * cegb_coupled[None, :] * \
                     (~st.feat_used)[None, :].astype(jnp.float32)
             if cegb_cfg.has_lazy:
-                rs = jnp.where(row_slot < 0, s, row_slot)
-                uncharged = jnp.zeros((s + 1, f), jnp.float32).at[rs].add(
-                    (~st.row_feat_used).astype(jnp.float32) *
-                    cnt_weight[:, None])[:s]
+                rs = st.row_node if mono_rescan else \
+                    jnp.where(row_slot < 0, s, row_slot)
+                uncharged = jnp.zeros((s_scan + 1, f), jnp.float32) \
+                    .at[rs].add((~st.row_feat_used).astype(jnp.float32) *
+                                cnt_weight[:, None])[:s_scan]
                 gp += cegb_cfg.tradeoff * cegb_lazy[None, :] * uncharged
         else:
             gp = None
-        mono_kw = dict(monotone=monotone, cons_min=st.cons_min[sn],
-                       cons_max=st.cons_max[sn], depth=tree.depth[sn],
+        if mono_rescan:
+            cons_min_s, cons_max_s = recompute_bounds(
+                tree, monotone, num_bins, method=monotone_method)
+        else:
+            cons_min_s, cons_max_s = st.cons_min[sn], st.cons_max[sn]
+        mono_kw = dict(monotone=monotone, cons_min=cons_min_s,
+                       cons_max=cons_max_s, depth=tree.depth[sn],
                        rand_bins=rand_bins, gain_penalty=gp)
 
         def scan_hist(h, fm):
@@ -329,8 +375,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 tree.leaf_value[sn], num_bins, missing_is_nan, is_cat_feat,
                 fm, hp, **mono_kw)
 
-        if comm is None:
-            bs = scan_hist(hist, slot_fmask)
+        if comm is None or (mono_rescan and comm.mode == "data"):
+            bs = scan_hist(hist, slot_fmask)  # cache already merged
         elif comm.mode == "data":
             # histogram merge == the ReduceScatter of
             # data_parallel_tree_learner.cpp:184-186; psum lets every device
@@ -385,7 +431,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             fb = forced_bin[sp]
             hsel = jnp.take_along_axis(
                 hist, ff[:, None, None, None], axis=1)[:, 0]  # [S, B, 3]
-            if rows_sharded:
+            if rows_sharded and not mono_rescan:  # cache already merged
                 hsel = jax.lax.psum(hsel, comm.axis)
             lmask = (jnp.arange(hist.shape[2])[None, :] <=
                      fb[:, None]).astype(hsel.dtype)
@@ -522,7 +568,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # monotone feature, mid = (l_out + r_out)/2 caps the increasing
         # side and floors the other — monotone_constraints.hpp
         # BasicLeafConstraints::UpdateConstraints)
-        if hp.has_monotone:
+        if hp.has_monotone and not mono_rescan:
             mcf = monotone[jnp.clip(feat, 0, f - 1)]
             mid = (best.left_output + best.right_output) * 0.5
             pmin, pmax = st.cons_min, st.cons_max
@@ -533,6 +579,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             cons_min = scat(st.cons_min, lmin, rmin)
             cons_max = scat(st.cons_max, lmax, rmax)
         else:
+            # intermediate/advanced recompute bounds from the whole tree
+            # at every pass start; the incremental arrays stay unused
             cons_min, cons_max = st.cons_min, st.cons_max
         if use_interaction:
             fsel = (jnp.arange(f)[None, :] ==
@@ -584,7 +632,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         return _GrowState(new_tree, row_node, slot_of_node, slot_nodes,
                           new_best, node_force, forced_ok, feat_used,
                           row_feat_used, cons_min, cons_max, path_mask,
-                          st.pass_idx + 1, done)
+                          hist_cache, st.pass_idx + 1, done)
 
     final = jax.lax.while_loop(cond, body, state)
     if use_cegb:

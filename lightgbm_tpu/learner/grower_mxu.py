@@ -181,7 +181,7 @@ def _select_rows(onehot: jax.Array, table: jax.Array) -> jax.Array:
                      "interaction_groups", "feature_fraction_bynode",
                      "interpret", "hist_double_prec", "tail_split_cap",
                      "hist_subtraction", "overshoot", "psum_axis",
-                     "quantized_grad"))
+                     "quantized_grad", "debug_info"))
 def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   cnt_weight: jax.Array, feature_mask: jax.Array,
                   num_bins: jax.Array, missing_is_nan: jax.Array,
@@ -197,7 +197,8 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   hist_subtraction: bool = True,
                   overshoot: float = 0.0,
                   psum_axis: Optional[str] = None,
-                  quantized_grad: bool = False
+                  quantized_grad: bool = False,
+                  debug_info: bool = False
                   ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree; same contract as grower.grow_tree (serial mode).
 
@@ -630,8 +631,10 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         return one_pass(s_fix, st, it + 1000, k_cap=k_fix,
                         sk_next=sk_fix), it + 1
 
-    state, _ = jax.lax.while_loop(
+    state, it_final = jax.lax.while_loop(
         cond, body, (state, jnp.asarray(len(schedule) + 1, jnp.int32)))
+    fixup_iters = it_final - (len(schedule) + 1)
+    pre_prune_leaves = state[0].num_leaves
 
     # flush the routing of the last pass's splits (sweeps route at the
     # START of a pass, so the final commits have not moved rows yet)
@@ -673,4 +676,6 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             sum_grad=jnp.where(lf, sums[:, 0], tree_out.sum_grad),
             sum_hess=jnp.where(lf, sums[:, 1], tree_out.sum_hess),
             count=jnp.where(lf, sums[:, 2], tree_out.count))
+    if debug_info:
+        return tree_out, row_node, (fixup_iters, pre_prune_leaves)
     return tree_out, row_node

@@ -49,7 +49,8 @@ def make_sharded_grower(mesh: Mesh, comm: CommSpec, *, num_leaves: int,
                         max_depth: int, hp, leafwise: bool, bmax: int,
                         feature_block: int = 8, use_mxu: bool = False,
                         mxu_kwargs: Optional[dict] = None,
-                        interpret: bool = False, monotone=None):
+                        interpret: bool = False, monotone=None,
+                        monotone_method: str = "basic"):
     """Build a shard_map'ped grower with the given static config.
 
     use_mxu (data-parallel only) runs the MXU grower inside shard_map
@@ -71,7 +72,8 @@ def make_sharded_grower(mesh: Mesh, comm: CommSpec, *, num_leaves: int,
         grower = functools.partial(
             grow_tree, num_leaves=num_leaves, max_depth=max_depth, hp=hp,
             leafwise=leafwise, bmax=bmax, feature_block=feature_block,
-            comm=comm, monotone=monotone)
+            comm=comm, monotone=monotone,
+            monotone_method=monotone_method)
 
     @functools.partial(
         shard_map, mesh=mesh,

@@ -147,3 +147,86 @@ class TestFeatureFractionByNode:
         from lightgbm_tpu.metrics import AUCMetric
         auc = AUCMetric._auc_fast(bst.predict(X), y > 0, np.ones(len(y)))
         assert auc > 0.9
+
+
+class TestMonotoneMethods:
+    """intermediate/advanced constraint methods: whole-tree bound
+    recompute + all-leaves rescan (reference monotone_constraints.hpp
+    IntermediateLeafConstraints :514 / AdvancedLeafConstraints :856)."""
+
+    @staticmethod
+    def _data(seed=0, n=3000):
+        r = np.random.RandomState(seed)
+        X = r.randn(n, 4)
+        y = (np.sin(2 * X[:, 0]) + 0.8 * X[:, 1] - 0.5 * X[:, 2] +
+             0.1 * r.randn(n)).astype(np.float32)
+        return X, y
+
+    @pytest.mark.parametrize("method", ["basic", "intermediate",
+                                        "advanced"])
+    def test_constraint_enforced(self, method):
+        X, y = self._data()
+        bst = lgb.train({"objective": "regression", "verbosity": -1,
+                         "monotone_constraints": [1, 0, -1, 0],
+                         "monotone_constraints_method": method,
+                         "num_leaves": 15}, lgb.Dataset(X, label=y), 15)
+        assert _is_monotone_increasing(bst, 0, X)
+        base = np.median(X, axis=0)
+        grid = np.linspace(X[:, 2].min(), X[:, 2].max(), 25)
+        rows = np.tile(base, (25, 1))
+        rows[:, 2] = grid
+        pred = bst.predict(rows, raw_score=True)
+        assert np.all(np.diff(pred) <= 1e-9)
+
+    def test_methods_quality_ordering(self):
+        # looser constraints should fit at least as well (the reason the
+        # reference grew 1184 LoC of them); allow small slack for
+        # greedy-order noise
+        X, y = self._data(seed=3, n=4000)
+        losses = {}
+        for method in ("basic", "intermediate", "advanced"):
+            bst = lgb.train({"objective": "regression", "verbosity": -1,
+                             "monotone_constraints": [1, 0, 0, 0],
+                             "monotone_constraints_method": method,
+                             "num_leaves": 31},
+                            lgb.Dataset(X, label=y), 25)
+            pr = bst.predict(X)
+            losses[method] = float(np.mean((pr - y) ** 2))
+        assert losses["intermediate"] <= losses["basic"] * 1.02
+        assert losses["advanced"] <= losses["basic"] * 1.02
+
+    def test_methods_differ_from_basic(self):
+        X, y = self._data(seed=4)
+        preds = {}
+        for method in ("basic", "intermediate", "advanced"):
+            bst = lgb.train({"objective": "regression", "verbosity": -1,
+                             "monotone_constraints": [1, 0, 0, 0],
+                             "monotone_constraints_method": method,
+                             "num_leaves": 31},
+                            lgb.Dataset(X, label=y), 10)
+            preds[method] = bst.predict(X)
+        assert not np.allclose(preds["basic"], preds["intermediate"])
+        assert not np.allclose(preds["basic"], preds["advanced"])
+
+    def test_bynode_downgrades_with_warning(self):
+        # reference config.cpp:386-390
+        X, y = self._data(seed=5)
+        cfg = lgb.Config({"objective": "regression",
+                          "monotone_constraints": [1, 0, 0, 0],
+                          "monotone_constraints_method": "advanced",
+                          "feature_fraction_bynode": 0.5})
+        assert cfg.monotone_constraints_method == "basic"
+
+    def test_distributed_intermediate(self):
+        # improvement over the reference (config.cpp:381-384 downgrades
+        # distributed): psum'd histogram caches support the rescan
+        import jax
+        if len(jax.devices()) < 2:
+            pytest.skip("needs multi-device")
+        X, y = self._data(seed=6, n=4096)
+        bst = lgb.train({"objective": "regression", "verbosity": -1,
+                         "monotone_constraints": [1, 0, 0, 0],
+                         "monotone_constraints_method": "intermediate",
+                         "tree_learner": "data", "num_leaves": 15},
+                        lgb.Dataset(X, label=y), 10)
+        assert _is_monotone_increasing(bst, 0, X)

@@ -8,6 +8,8 @@ hardware. Equality target: grower.grow_tree with identical inputs.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # Pallas interpret mode: minutes per test
+
 import jax
 import jax.numpy as jnp
 

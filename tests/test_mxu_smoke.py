@@ -1,0 +1,44 @@
+"""Fast MXU-path smoke test kept in the DEFAULT suite (the exhaustive
+kernel parity matrix lives in test_mxu_kernels.py behind -m slow)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.data import BinnedDataset, Metadata
+from lightgbm_tpu.learner.grower import grow_tree
+from lightgbm_tpu.learner.grower_mxu import grow_tree_mxu
+from lightgbm_tpu.learner.split import SplitHyperParams
+
+
+def test_mxu_grower_matches_portable_small():
+    rng = np.random.RandomState(0)
+    n = 1200
+    X = rng.randn(n, 5).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    ds = BinnedDataset.from_raw(X, Metadata(n, label=y), max_bin=31)
+    g = jnp.asarray(0.5 - y)
+    h = jnp.full(n, 0.25, jnp.float32)
+    cnt = jnp.ones(n, jnp.float32)
+    args = (jnp.asarray(ds.bins), g, h, cnt,
+            jnp.ones(ds.num_features, jnp.float32),
+            jnp.asarray(ds.num_bins), jnp.asarray(ds.missing_types == 2),
+            jnp.asarray(ds.is_categorical))
+    kw = dict(num_leaves=7, max_depth=0,
+              hp=SplitHyperParams(min_data_in_leaf=20),
+              bmax=int(ds.num_bins.max()))
+    t_ref, r_ref = grow_tree(*args, leafwise=False, **kw)
+    t_mxu, r_mxu = grow_tree_mxu(*args, interpret=True, **kw)
+    nn = int(t_ref.num_nodes)
+    assert int(t_mxu.num_nodes) == nn
+    np.testing.assert_array_equal(
+        np.asarray(t_ref.split_feature)[:nn],
+        np.asarray(t_mxu.split_feature)[:nn])
+    np.testing.assert_array_equal(
+        np.asarray(t_ref.threshold_bin)[:nn],
+        np.asarray(t_mxu.threshold_bin)[:nn])
+    np.testing.assert_allclose(np.asarray(t_ref.leaf_value)[:nn],
+                               np.asarray(t_mxu.leaf_value)[:nn],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(r_ref), np.asarray(r_mxu))
