@@ -26,13 +26,20 @@ from .utils.log import Log, LightGBMError
 __all__ = ["Dataset", "Booster", "LightGBMError"]
 
 
+def _is_sparse(data) -> bool:
+    """scipy sparse matrix/array, duck-typed (no hard scipy import)."""
+    return hasattr(data, "tocsc") and hasattr(data, "nnz")
+
+
 def _to_2d_float(data) -> np.ndarray:
     if hasattr(data, "values") and not isinstance(data, np.ndarray):
         data = data.values  # pandas
+    if _is_sparse(data):
+        return np.ascontiguousarray(data.toarray(), dtype=np.float64)
     arr = np.asarray(data)
     if arr.ndim == 1:
         arr = arr.reshape(-1, 1)
-    if hasattr(data, "tocsr") or hasattr(arr, "toarray"):
+    if hasattr(arr, "toarray"):
         arr = arr.toarray()
     return np.ascontiguousarray(arr, dtype=np.float64)
 
@@ -117,7 +124,10 @@ class Dataset:
             if self.label is None:
                 self.label, raw = raw[:, 0], raw[:, 1:]
             data = raw
-        X = _to_2d_float(data)
+        sparse_in = _is_sparse(data)
+        # sparse stays sparse through binning (reference SparseBin /
+        # __init_from_csr): only the uint8 bin matrix is densified
+        X = data if sparse_in else _to_2d_float(data)
         names: Optional[List[str]] = None
         if self.feature_name != "auto" and self.feature_name is not None:
             names = list(self.feature_name)
@@ -134,6 +144,8 @@ class Dataset:
         elif cfg.categorical_feature:
             cat = [int(c) for c in str(cfg.categorical_feature).split(",")
                    if c != ""]
+        construct_binned = (BinnedDataset.from_sparse if sparse_in
+                            else BinnedDataset.from_raw)
         label = None if self.label is None else \
             np.asarray(self.label, dtype=np.float32).reshape(-1)
         md = Metadata(X.shape[0], label=label,
@@ -155,7 +167,7 @@ class Dataset:
                 full[int(f)] = ref.mappers[j]
             trivial = BinMapper()
             ref_mappers = [m if m is not None else trivial for m in full]
-            self._binned = BinnedDataset.from_raw(
+            self._binned = construct_binned(
                 X, md, max_bin=cfg.max_bin,
                 min_data_in_bin=cfg.min_data_in_bin,
                 mappers=ref_mappers, feature_names=names,
@@ -168,7 +180,7 @@ class Dataset:
                 raw=None if self._binned.raw is None
                 else self._binned.raw[:, keep])
         else:
-            self._binned = BinnedDataset.from_raw(
+            self._binned = construct_binned(
                 X, md, max_bin=cfg.max_bin,
                 min_data_in_bin=cfg.min_data_in_bin,
                 sample_cnt=cfg.bin_construct_sample_cnt,
@@ -487,13 +499,25 @@ class Booster:
                 pred_early_stop_margin: float = 10.0,
                 **kwargs) -> np.ndarray:
         model = self._host_model()
-        X = _to_2d_float(data)
-        return model.predict(X, start_iteration=start_iteration,
-                             num_iteration=num_iteration, raw_score=raw_score,
-                             pred_leaf=pred_leaf, pred_contrib=pred_contrib,
-                             pred_early_stop=pred_early_stop,
-                             pred_early_stop_freq=pred_early_stop_freq,
-                             pred_early_stop_margin=pred_early_stop_margin)
+        kw = dict(start_iteration=start_iteration,
+                  num_iteration=num_iteration, raw_score=raw_score,
+                  pred_leaf=pred_leaf, pred_contrib=pred_contrib,
+                  pred_early_stop=pred_early_stop,
+                  pred_early_stop_freq=pred_early_stop_freq,
+                  pred_early_stop_margin=pred_early_stop_margin)
+        if _is_sparse(data):
+            # densify in row chunks so wide-sparse inputs never need the
+            # full dense matrix in memory (reference predicts CSR rows
+            # natively, c_api.cpp PredictForCSR)
+            csr = data.tocsr()
+            if csr.shape[0] == 0:
+                return model.predict(
+                    np.zeros((0, csr.shape[1]), np.float64), **kw)
+            chunk = max(1, int(32 << 20) // max(1, 8 * csr.shape[1]))
+            outs = [model.predict(_to_2d_float(csr[i:i + chunk]), **kw)
+                    for i in range(0, csr.shape[0], chunk)]
+            return np.concatenate(outs, axis=0)
+        return model.predict(_to_2d_float(data), **kw)
 
     def refit(self, data, label, decay_rate: Optional[float] = None,
               **kwargs) -> "Booster":

@@ -377,7 +377,8 @@ class Config:
                     "tree learner, auto set to \"basic\" method.",
                     requested_mc_method)
                 self.monotone_constraints_method = "basic"
-            if self.feature_fraction_bynode != 1.0:
+            if self.feature_fraction_bynode != 1.0 and \
+                    self.monotone_constraints_method != "basic":
                 # reference config.cpp:386-390: by-node sampling would
                 # resample on every recompute-triggered re-find
                 from .utils.log import Log

@@ -85,6 +85,23 @@ class Metadata:
         return np.repeat(np.arange(len(sizes), dtype=np.int32), sizes)
 
 
+def _select_used_features(all_mappers, pre_filter: bool):
+    """Shared dense/sparse ingestion prologue: drop trivial features
+    (reference feature_pre_filter), pick the bin-matrix dtype."""
+    used, used_mappers = [], []
+    for f, m in enumerate(all_mappers):
+        if pre_filter and m.is_trivial:
+            continue
+        used.append(f)
+        used_mappers.append(m)
+    if not used:
+        Log.warning("All features are trivial (constant); nothing to learn")
+    used = np.array(used, dtype=np.int32)
+    max_num_bin = max([m.num_bin for m in used_mappers], default=2)
+    dtype = np.uint8 if max_num_bin <= 256 else np.uint16
+    return used, used_mappers, dtype
+
+
 class BinnedDataset:
     """Quantized dataset: `[num_data, num_used_features]` bin matrix.
 
@@ -149,17 +166,8 @@ class BinnedDataset:
                 raise ValueError(
                     f"got {len(mappers)} bin mappers for {num_total} features")
             all_mappers = mappers
-        used, used_mappers = [], []
-        for f, m in enumerate(all_mappers):
-            if feature_pre_filter and m.is_trivial and mappers is None:
-                continue
-            used.append(f)
-            used_mappers.append(m)
-        if not used:
-            Log.warning("All features are trivial (constant); nothing to learn")
-        used = np.array(used, dtype=np.int32)
-        max_num_bin = max([m.num_bin for m in used_mappers], default=2)
-        dtype = np.uint8 if max_num_bin <= 256 else np.uint16
+        used, used_mappers, dtype = _select_used_features(
+            all_mappers, feature_pre_filter and mappers is None)
         binned = np.empty((num_data, len(used)), dtype=dtype)
         for j, f in enumerate(used):
             binned[:, j] = used_mappers[j].values_to_bins(
@@ -168,6 +176,56 @@ class BinnedDataset:
             X[:, used], dtype=np.float32) if keep_raw else None
         return BinnedDataset(binned, used_mappers, used, num_total, metadata,
                              feature_names, raw=raw)
+
+    @staticmethod
+    def from_sparse(X, metadata: Metadata, max_bin: int = 255,
+                    min_data_in_bin: int = 3, sample_cnt: int = 200000,
+                    use_missing: bool = True, zero_as_missing: bool = False,
+                    categorical_features: Optional[Sequence[int]] = None,
+                    seed: int = 1,
+                    feature_names: Optional[List[str]] = None,
+                    mappers: Optional[List[BinMapper]] = None,
+                    feature_pre_filter: bool = True,
+                    keep_raw: bool = False) -> "BinnedDataset":
+        """Quantize a scipy CSR/CSC matrix without densifying the raw
+        values: bin mappers come from per-column stored values (+ implicit
+        zero counts), and only the uint8/16 bin matrix is materialized —
+        the memory shape of the reference's SparseBin ingestion
+        (sparse_bin.hpp:73, python-package basic.py __init_from_csr)."""
+        if keep_raw:
+            raise ValueError(
+                "linear_tree requires dense input (leaf linear models "
+                "need raw feature values)")
+        X = X.tocsc()
+        if not getattr(X, "has_sorted_indices", True):
+            X.sort_indices()
+        num_data, num_total = X.shape
+        if mappers is None:
+            from .binning import find_bin_mappers_sparse
+            all_mappers = find_bin_mappers_sparse(
+                X, max_bin=max_bin, min_data_in_bin=min_data_in_bin,
+                sample_cnt=sample_cnt, use_missing=use_missing,
+                zero_as_missing=zero_as_missing,
+                categorical_features=categorical_features, seed=seed)
+        else:
+            if len(mappers) != num_total:
+                raise ValueError(
+                    f"got {len(mappers)} bin mappers for {num_total} "
+                    f"features")
+            all_mappers = mappers
+        used, used_mappers, dtype = _select_used_features(
+            all_mappers, feature_pre_filter and mappers is None)
+        binned = np.empty((num_data, len(used)), dtype=dtype)
+        indptr, indices, vals = X.indptr, X.indices, X.data
+        for j, f in enumerate(used):
+            m = used_mappers[j]
+            lo, hi = int(indptr[f]), int(indptr[f + 1])
+            binned[:, j] = m._value_to_bin_scalar(0.0)
+            if hi > lo:
+                binned[indices[lo:hi], j] = m.values_to_bins(
+                    np.asarray(vals[lo:hi], dtype=np.float64)).astype(dtype)
+        return BinnedDataset(binned, used_mappers, used, num_total,
+                             metadata, feature_names, raw=None)
 
     # ---- accessors ----------------------------------------------------
     @property

@@ -40,6 +40,7 @@ from .histogram_mxu import (_round_up, build_histograms_mxu_auto, fits_v2,
                             quantize_gradients, route_rows_mxu)
 from .split import (BestSplits, SplitHyperParams, find_best_splits,
                     leaf_output)
+from .split_kernel import find_best_splits_kernel, kernel_supports
 
 __all__ = ["grow_tree_mxu"]
 
@@ -181,7 +182,7 @@ def _select_rows(onehot: jax.Array, table: jax.Array) -> jax.Array:
                      "interaction_groups", "feature_fraction_bynode",
                      "interpret", "hist_double_prec", "tail_split_cap",
                      "hist_subtraction", "overshoot", "psum_axis",
-                     "quantized_grad", "debug_info"))
+                     "quantized_grad", "use_scan_kernel", "debug_info"))
 def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   cnt_weight: jax.Array, feature_mask: jax.Array,
                   num_bins: jax.Array, missing_is_nan: jax.Array,
@@ -198,6 +199,7 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   overshoot: float = 0.0,
                   psum_axis: Optional[str] = None,
                   quantized_grad: bool = False,
+                  use_scan_kernel: bool = False,
                   debug_info: bool = False
                   ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree; same contract as grower.grow_tree (serial mode).
@@ -402,12 +404,25 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                     pass_idx)
             rand_bins = jax.random.randint(kr, (s, f), 0, bmax)
 
-        bs = find_best_splits(
-            hist, tree.sum_grad[sn], tree.sum_hess[sn], tree.count[sn],
-            tree.leaf_value[sn], num_bins, missing_is_nan, is_cat_feat,
-            slot_fmask, hp, monotone=monotone, cons_min=cons_min[sn],
-            cons_max=cons_max[sn], depth=tree.depth[sn],
-            rand_bins=rand_bins)
+        # fused single-launch scan kernel (split_kernel.py, the
+        # CUDABestSplitFinder analog). Measured ~4% SLOWER than the XLA
+        # scan in-context on v5e (the scan is NOT this backend's
+        # bottleneck; XLA fuses it well) — kept opt-in for backends
+        # where launch overhead dominates.
+        if use_scan_kernel and kernel_supports(hp) and rand_bins is None:
+            bs = find_best_splits_kernel(
+                hist, tree.sum_grad[sn], tree.sum_hess[sn], tree.count[sn],
+                tree.leaf_value[sn], num_bins, missing_is_nan, is_cat_feat,
+                slot_fmask, hp, monotone=monotone, cons_min=cons_min[sn],
+                cons_max=cons_max[sn], depth=tree.depth[sn],
+                interpret=interpret)
+        else:
+            bs = find_best_splits(
+                hist, tree.sum_grad[sn], tree.sum_hess[sn], tree.count[sn],
+                tree.leaf_value[sn], num_bins, missing_is_nan, is_cat_feat,
+                slot_fmask, hp, monotone=monotone, cons_min=cons_min[sn],
+                cons_max=cons_max[sn], depth=tree.depth[sn],
+                rand_bins=rand_bins)
 
         best = BestSplits(*[
             getattr(best, fld).at[sn].set(getattr(bs, fld))
