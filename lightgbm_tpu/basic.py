@@ -516,6 +516,13 @@ class Booster:
             chunk = max(1, int(32 << 20) // max(1, 8 * csr.shape[1]))
             outs = [model.predict(_to_2d_float(csr[i:i + chunk]), **kw)
                     for i in range(0, csr.shape[0], chunk)]
+            if pred_contrib:
+                # contribs are [n, F+1]: dense would defeat the chunking
+                # on wide-sparse inputs; the reference also returns a
+                # sparse matrix for sparse contrib input (c_api
+                # PredictForCSR contrib path)
+                import scipy.sparse as _sp
+                return _sp.vstack([_sp.csr_matrix(o) for o in outs])
             return np.concatenate(outs, axis=0)
         return model.predict(_to_2d_float(data), **kw)
 

@@ -320,17 +320,21 @@ class GBDT:
                    self._forced is None and self._cegb_cfg is None and
                    not self._mono_nonbasic)
         self._sharded_mxu = use_mxu
-        if cfg.feature_fraction_bynode < 1.0 or cfg.extra_trees or \
-                self._interaction_groups:
-            Log.warning("feature_fraction_bynode/extra_trees/interaction_"
-                        "constraints are not supported with distributed "
-                        "tree learners yet; ignoring them")
+        # per-node sampling / extra_trees / quantized rounding need a
+        # per-iteration key; it rides into shard_map replicated so every
+        # shard samples identically (the reference's cross-machine seed
+        # sync, application.cpp:170-175)
+        self._sharded_rng = (cfg.feature_fraction_bynode < 1.0 or
+                             cfg.extra_trees or cfg.use_quantized_grad)
         self._grower = make_sharded_grower(
             self.mesh, self.comm, num_leaves=cfg.num_leaves,
             max_depth=cfg.max_depth, hp=self.hp,
             leafwise=self._mono_nonbasic,
             bmax=self.bmax, use_mxu=use_mxu, monotone=self._monotone,
             monotone_method=self._mono_method,
+            interaction_groups=self._interaction_groups,
+            feature_fraction_bynode=cfg.feature_fraction_bynode,
+            with_rng=self._sharded_rng,
             mxu_kwargs=dict(
                 hist_double_prec=cfg.gpu_use_dp,
                 tail_split_cap=cfg.tail_split_cap,
@@ -389,10 +393,14 @@ class GBDT:
             g = jnp.pad(g, (0, self._row_pad))
             h = jnp.pad(h, (0, self._row_pad))
             cnt = jnp.pad(cnt, (0, self._row_pad))
+        extra = ()
+        if getattr(self, "_sharded_rng", False):
+            extra = (jax.random.fold_in(
+                jax.random.PRNGKey(cfg.extra_seed), self.iter_),)
         with self.mesh:
             tree, row_node = self._grower(
                 self.bins, g, h, cnt, feature_mask, self.num_bins_d,
-                self.missing_is_nan_d, self.is_cat_d)
+                self.missing_is_nan_d, self.is_cat_d, *extra)
         return tree, row_node[:self.num_data]
 
     def _predict_train_rows(self, tree: TreeArrays) -> jax.Array:

@@ -197,6 +197,11 @@ class BinnedDataset:
                 "linear_tree requires dense input (leaf linear models "
                 "need raw feature values)")
         X = X.tocsc()
+        # canonicalize: scipy allows duplicate (row, col) entries whose
+        # semantic value is the SUM; without this, fancy-index binning
+        # would keep only the last duplicate while dense paths sum
+        if hasattr(X, "sum_duplicates"):
+            X.sum_duplicates()
         if not getattr(X, "has_sorted_indices", True):
             X.sort_indices()
         num_data, num_total = X.shape

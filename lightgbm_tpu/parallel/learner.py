@@ -50,7 +50,10 @@ def make_sharded_grower(mesh: Mesh, comm: CommSpec, *, num_leaves: int,
                         feature_block: int = 8, use_mxu: bool = False,
                         mxu_kwargs: Optional[dict] = None,
                         interpret: bool = False, monotone=None,
-                        monotone_method: str = "basic"):
+                        monotone_method: str = "basic",
+                        interaction_groups: Optional[tuple] = None,
+                        feature_fraction_bynode: float = 1.0,
+                        with_rng: bool = False):
     """Build a shard_map'ped grower with the given static config.
 
     use_mxu (data-parallel only) runs the MXU grower inside shard_map
@@ -58,7 +61,14 @@ def make_sharded_grower(mesh: Mesh, comm: CommSpec, *, num_leaves: int,
     DataParallelTreeLearner's histogram Reduce-Scatter
     (data_parallel_tree_learner.cpp:184-186). Other modes (and the CPU
     fallback) keep the portable scatter grower, whose collectives live
-    inside grow_tree itself."""
+    inside grow_tree itself.
+
+    with_rng=True adds a replicated rng_key argument (the 9th) so
+    per-node feature sampling / extra_trees / quantized rounding take a
+    per-iteration key: every shard holds the identical key, samples the
+    identical masks, and therefore takes identical split decisions — the
+    reference syncs sampling seeds across machines the same way
+    (application.cpp:170-175 GlobalSyncUpByMin of seeds)."""
     axis = comm.axis
     data_spec = P(axis) if comm.mode in ("data", "voting") else P()
 
@@ -67,23 +77,30 @@ def make_sharded_grower(mesh: Mesh, comm: CommSpec, *, num_leaves: int,
         grower = functools.partial(
             grow_tree_mxu, num_leaves=num_leaves, max_depth=max_depth,
             hp=hp, bmax=bmax, psum_axis=axis, interpret=interpret,
-            monotone=monotone, **(mxu_kwargs or {}))
+            monotone=monotone, interaction_groups=interaction_groups,
+            feature_fraction_bynode=feature_fraction_bynode,
+            **(mxu_kwargs or {}))
     else:
         grower = functools.partial(
             grow_tree, num_leaves=num_leaves, max_depth=max_depth, hp=hp,
             leafwise=leafwise, bmax=bmax, feature_block=feature_block,
             comm=comm, monotone=monotone,
-            monotone_method=monotone_method)
+            monotone_method=monotone_method,
+            interaction_groups=interaction_groups,
+            feature_fraction_bynode=feature_fraction_bynode)
+
+    in_specs = (data_spec, data_spec, data_spec, data_spec,
+                P(), P(), P(), P()) + ((P(),) if with_rng else ())
 
     @functools.partial(
         shard_map, mesh=mesh,
-        in_specs=(data_spec, data_spec, data_spec, data_spec,
-                  P(), P(), P(), P()),
+        in_specs=in_specs,
         out_specs=(P(), data_spec),
         check_vma=False)
     def sharded(bins, grad, hess, cnt, feature_mask, num_bins,
-                missing_is_nan, is_cat):
+                missing_is_nan, is_cat, *maybe_key):
         return grower(bins, grad, hess, cnt, feature_mask, num_bins,
-                      missing_is_nan, is_cat)
+                      missing_is_nan, is_cat,
+                      **({"rng_key": maybe_key[0]} if maybe_key else {}))
 
     return jax.jit(sharded)

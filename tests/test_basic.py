@@ -326,3 +326,35 @@ class TestSparseInput:
         assert d._binned.bins.dtype == np.uint8
         # peak python allocations stay far under the dense-raw footprint
         assert peak < 120 * 1024 * 1024, f"peak {peak/1e6:.0f} MB"
+
+    def test_sparse_duplicates_summed(self):
+        scipy_sparse = pytest.importorskip("scipy.sparse")
+        # duplicate COO entries mean SUM in scipy; binning must agree
+        # with the dense equivalent
+        rows = np.array([0, 0, 1, 2]); cols = np.array([1, 1, 0, 2])
+        vals = np.array([2.0, 3.0, 1.0, -1.0])
+        Xs = scipy_sparse.csr_matrix((vals, (rows, cols)), shape=(40, 3))
+        Xd = np.asarray(Xs.todense())
+        r = np.random.RandomState(0)
+        Xd2 = Xd + 0.0; Xd2[3:] = r.randn(37, 3)
+        Xs2 = scipy_sparse.csr_matrix(
+            (np.concatenate([vals, Xd2[3:].ravel()]),
+             (np.concatenate([rows, np.repeat(np.arange(3, 40), 3)]),
+              np.concatenate([cols, np.tile(np.arange(3), 37)]))),
+            shape=(40, 3))
+        y = (Xd2[:, 0] > 0).astype(np.float32)
+        dd = lgb.Dataset(Xd2, label=y, params={"min_data_in_bin": 1})
+        ds = lgb.Dataset(Xs2, label=y, params={"min_data_in_bin": 1})
+        dd.construct(); ds.construct()
+        np.testing.assert_array_equal(dd._binned.bins, ds._binned.bins)
+
+    def test_sparse_pred_contrib_returns_sparse(self):
+        scipy_sparse = pytest.importorskip("scipy.sparse")
+        Xd, Xs, y = self._sparse_data(seed=4)
+        bst = lgb.train({"objective": "binary", "verbosity": -1,
+                         "num_leaves": 7}, lgb.Dataset(Xs, label=y), 5)
+        contrib = bst.predict(Xs[:100], pred_contrib=True)
+        assert scipy_sparse.issparse(contrib)
+        dense_contrib = bst.predict(Xd[:100], pred_contrib=True)
+        np.testing.assert_allclose(np.asarray(contrib.todense()),
+                                   dense_contrib, rtol=1e-5, atol=1e-6)
