@@ -9,6 +9,7 @@ and data-/feature-/voting-parallel training over `jax.sharding` meshes.
 __version__ = "0.1.0"
 
 from .config import Config
+from .parallel import setup_multihost
 from .utils.log import LightGBMError, register_logger
 
 try:  # user-facing API (available once all layers are built)
@@ -23,4 +24,4 @@ except ImportError:  # pragma: no cover - during partial builds only
 __all__ = ["Dataset", "Booster", "train", "cv", "Config", "LightGBMError",
            "register_logger", "early_stopping", "log_evaluation",
            "record_evaluation", "reset_parameter", "plot_importance",
-           "plot_metric", "plot_tree", "__version__"]
+           "plot_metric", "plot_tree", "setup_multihost", "__version__"]

@@ -53,6 +53,51 @@ def _load_svmlight_or_csv(path: str) -> np.ndarray:
     return np.loadtxt(path, delimiter=delim)
 
 
+def _distributed_bin_mappers(X, cfg, cat, sparse_in):
+    """Multi-machine bin finding: every rank contributes an equal-size
+    sample of its local rows via allgather, and all ranks derive
+    IDENTICAL BinMappers from the union — the TPU form of the
+    reference's per-rank FindBin + Allgather of serialized mappers
+    (dataset_loader.cpp:722-807). Returns None single-process."""
+    import jax
+    try:
+        if jax.process_count() <= 1:
+            return None
+    except RuntimeError:
+        return None
+    from jax.experimental import multihost_utils
+    from .binning import find_bin_mappers
+    nproc = jax.process_count()
+    per = max(1, cfg.bin_construct_sample_cnt // nproc)
+    n_local = X.shape[0]
+    # variable-size sample gather with fixed wire shapes: every rank
+    # ships `per` rows (zero-padded) plus its true count, and the
+    # padding is stripped after the gather — the reference's
+    # variable-size mapper allgather (dataset_loader.cpp:722-807)
+    n_samp = min(per, n_local)
+    if n_local > n_samp:
+        rng = np.random.RandomState(cfg.data_random_seed)
+        idx = np.sort(rng.choice(n_local, size=n_samp, replace=False))
+        sample = X[idx]
+    else:
+        sample = X[:n_samp]
+    if sparse_in:
+        sample = sample.toarray()  # densify the sample rows only
+    sample = np.ascontiguousarray(sample, dtype=np.float64)
+    if n_samp < per:
+        sample = np.pad(sample, ((0, per - n_samp), (0, 0)))
+    sizes = np.asarray(multihost_utils.process_allgather(
+        np.asarray(n_samp, np.int64)))
+    gathered = np.asarray(multihost_utils.process_allgather(sample))
+    union = np.concatenate(
+        [gathered[r, :int(sizes[r])] for r in range(nproc)])
+    return find_bin_mappers(
+        union, max_bin=cfg.max_bin, min_data_in_bin=cfg.min_data_in_bin,
+        sample_cnt=len(union), use_missing=cfg.use_missing,
+        zero_as_missing=cfg.zero_as_missing, categorical_features=cat,
+        seed=cfg.data_random_seed)
+
+
 class Dataset:
     """Lazily-constructed binned dataset (reference basic.py:1163)."""
 
@@ -180,6 +225,7 @@ class Dataset:
                 raw=None if self._binned.raw is None
                 else self._binned.raw[:, keep])
         else:
+            dist_mappers = _distributed_bin_mappers(X, cfg, cat, sparse_in)
             self._binned = construct_binned(
                 X, md, max_bin=cfg.max_bin,
                 min_data_in_bin=cfg.min_data_in_bin,
@@ -189,7 +235,8 @@ class Dataset:
                 categorical_features=cat, seed=cfg.data_random_seed,
                 feature_names=names,
                 feature_pre_filter=cfg.feature_pre_filter,
-                keep_raw=cfg.linear_tree)
+                keep_raw=cfg.linear_tree, mappers=dist_mappers,
+                pre_filter_with_mappers=dist_mappers is not None)
         if self.free_raw_data:
             self.data = None
         return self

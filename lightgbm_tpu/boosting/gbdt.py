@@ -290,6 +290,15 @@ class GBDT:
         self._row_pad = 0
         if cfg.tree_learner == "serial":
             return
+        if cfg.num_machines > 1:
+            # reference Network::Init from the machine list
+            # (application.cpp:165); here a jax.distributed rendezvous —
+            # afterwards jax.devices() spans all hosts and the mesh
+            # collectives ride DCN between them
+            from ..parallel.mesh import setup_multihost
+            setup_multihost(cfg.num_machines, cfg.machines,
+                            cfg.machine_list_filename,
+                            cfg.local_listen_port)
         ndev = cfg.num_devices if cfg.num_devices > 0 else len(jax.devices())
         ndev = min(ndev, len(jax.devices()))
         if ndev <= 1:
@@ -299,16 +308,36 @@ class GBDT:
         from ..parallel import CommSpec, make_mesh
         from ..parallel.learner import make_sharded_grower
         from jax.sharding import NamedSharding, PartitionSpec as P
+        self._nproc = jax.process_count()
+        if self._nproc > 1 and cfg.tree_learner != "data":
+            raise ValueError(
+                "multi-machine training supports tree_learner=data "
+                "(rows pre-partitioned per machine, reference "
+                "dataset_loader.cpp:560-592); got %r" % cfg.tree_learner)
         self.mesh = make_mesh(ndev)
         self.comm = CommSpec(axis="data", mode=cfg.tree_learner,
                              num_devices=ndev, top_k=cfg.top_k)
         if self.comm.mode in ("data", "voting"):
-            self._row_pad = (-self.num_data) % ndev
+            ndev_local = max(1, ndev // self._nproc)
+            self._row_pad = (-self.num_data) % ndev_local
             if self._row_pad:
                 self.bins = jnp.pad(self.bins,
                                     ((0, self._row_pad), (0, 0)))
-            self.bins = jax.device_put(
-                self.bins, NamedSharding(self.mesh, P("data")))
+            if self._nproc > 1:
+                # keep this machine's rows for local score updates /
+                # metrics (reference ranks evaluate on their partition)
+                self._local_bins = self.bins
+                # global shape is inferred from the local shard, so all
+                # machines must hold equally many (padded) rows
+                from jax.experimental import multihost_utils
+                sizes = np.asarray(multihost_utils.process_allgather(
+                    np.asarray(self.bins.shape[0], np.int64)))
+                if len(set(sizes.tolist())) != 1:
+                    raise ValueError(
+                        "multi-machine data-parallel training needs "
+                        "equal row counts per machine (got %s); pad or "
+                        "re-partition the data" % sizes.tolist())
+            self.bins = self._shard_rows(self.bins)
         else:  # feature-parallel replicates rows (docs/Features.rst:109)
             self.bins = jax.device_put(
                 self.bins, NamedSharding(self.mesh, P()))
@@ -343,6 +372,29 @@ class GBDT:
                 quantized_grad=cfg.use_quantized_grad))
         Log.info("Distributed learner: %s-parallel over %d devices%s",
                  self.comm.mode, ndev, " (mxu)" if use_mxu else "")
+
+    def _shard_rows(self, arr):
+        """Row-sharded global array over the mesh. Single-process: a
+        device_put; multi-process: this process's rows become its shard
+        of the global array (each machine holds its own partition, the
+        reference's pre-partitioned load, dataset_loader.cpp:560-592)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sh = NamedSharding(self.mesh, P("data"))
+        if getattr(self, "_nproc", 1) > 1:
+            return jax.make_array_from_process_local_data(
+                sh, np.asarray(arr))
+        return jax.device_put(arr, sh)
+
+    def _local_rows(self, arr) -> jax.Array:
+        """This process's rows of a row-sharded global array (index
+        order), for the host-local score/metric bookkeeping."""
+        if getattr(self, "_nproc", 1) <= 1:
+            return arr
+        shards = sorted(arr.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        # shards live on different local devices; hop through host
+        return jnp.asarray(np.concatenate(
+            [np.asarray(s.data) for s in shards]))
 
     def _grow(self, g, h, cnt, feature_mask):
         """Dispatch serial vs sharded growth; returns (tree, row_node[:N])."""
@@ -393,6 +445,9 @@ class GBDT:
             g = jnp.pad(g, (0, self._row_pad))
             h = jnp.pad(h, (0, self._row_pad))
             cnt = jnp.pad(cnt, (0, self._row_pad))
+        if self.comm.mode in ("data", "voting") and \
+                getattr(self, "_nproc", 1) > 1:
+            g, h, cnt = (self._shard_rows(a) for a in (g, h, cnt))
         extra = ()
         if getattr(self, "_sharded_rng", False):
             extra = (jax.random.fold_in(
@@ -401,11 +456,13 @@ class GBDT:
             tree, row_node = self._grower(
                 self.bins, g, h, cnt, feature_mask, self.num_bins_d,
                 self.missing_is_nan_d, self.is_cat_d, *extra)
-        return tree, row_node[:self.num_data]
+        return tree, self._local_rows(row_node)[:self.num_data]
 
     def _predict_train_rows(self, tree: TreeArrays) -> jax.Array:
         """Tree outputs for the (unpadded) training rows."""
-        vals = predict_binned_tree(tree, self.bins, self.num_bins_d,
+        bins = self._local_bins if getattr(self, "_nproc", 1) > 1 \
+            else self.bins
+        vals = predict_binned_tree(tree, bins, self.num_bins_d,
                                    self.missing_is_nan_d)
         return vals[:self.num_data] if self._row_pad else vals
 
@@ -640,6 +697,13 @@ class GBDT:
                 not cfg.boost_from_average):
             return 0.0
         init = self.objective.boost_from_score(cls)
+        if getattr(self, "_nproc", 1) > 1:
+            # reference gbdt.cpp:335-344: init scores are averaged across
+            # machines (GlobalSyncUpByMean), each rank having computed
+            # from its local partition
+            from jax.experimental import multihost_utils
+            init = float(np.mean(multihost_utils.process_allgather(
+                np.float32(init))))
         if abs(init) > 1e-35:
             self._add_const_score(init, cls)
             Log.info("Start training from score %f", init)

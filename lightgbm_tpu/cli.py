@@ -118,6 +118,13 @@ class Application:
 
     def run(self) -> None:
         task = self.config.task
+        if task == "train" and self.config.num_machines > 1:
+            # before any data/backend work, like the reference's
+            # Network::Init at InitTrain start (application.cpp:165)
+            from .parallel import setup_multihost
+            setup_multihost(self.config.num_machines, self.config.machines,
+                            self.config.machine_list_filename,
+                            self.config.local_listen_port)
         if task == "train":
             self.train()
         elif task in ("predict", "prediction", "test"):

@@ -362,6 +362,15 @@ class Config:
             full = 1 << min(self.max_depth, 30)
             if self.num_leaves > full:
                 self.num_leaves = full
+        if self.num_machines > 1 and self.tree_learner == "serial":
+            # reference config.cpp:293-299: serial learner forces
+            # single-machine (theirs is silent; warn so nobody believes
+            # N independent per-partition models are one model)
+            from .utils.log import Log
+            Log.warning(
+                "num_machines > 1 requires a parallel tree_learner "
+                "(data/feature/voting); forcing num_machines=1")
+            self.num_machines = 1
         requested_mc_method = self.monotone_constraints_method
         if self.monotone_constraints is not None and \
                 requested_mc_method in ("intermediate", "advanced"):

@@ -147,7 +147,8 @@ class BinnedDataset:
                  seed: int = 1, feature_names: Optional[List[str]] = None,
                  mappers: Optional[List[BinMapper]] = None,
                  feature_pre_filter: bool = True,
-                 keep_raw: bool = False) -> "BinnedDataset":
+                 keep_raw: bool = False,
+                 pre_filter_with_mappers: bool = False) -> "BinnedDataset":
         """Quantize raw features. If `mappers` given, reuse them (aligned
         valid set — reference LoadFromFileAlignWithOtherDataset,
         dataset_loader.cpp:299)."""
@@ -167,7 +168,8 @@ class BinnedDataset:
                     f"got {len(mappers)} bin mappers for {num_total} features")
             all_mappers = mappers
         used, used_mappers, dtype = _select_used_features(
-            all_mappers, feature_pre_filter and mappers is None)
+            all_mappers, feature_pre_filter and
+            (mappers is None or pre_filter_with_mappers))
         binned = np.empty((num_data, len(used)), dtype=dtype)
         for j, f in enumerate(used):
             binned[:, j] = used_mappers[j].values_to_bins(
@@ -186,7 +188,9 @@ class BinnedDataset:
                     feature_names: Optional[List[str]] = None,
                     mappers: Optional[List[BinMapper]] = None,
                     feature_pre_filter: bool = True,
-                    keep_raw: bool = False) -> "BinnedDataset":
+                    keep_raw: bool = False,
+                    pre_filter_with_mappers: bool = False
+                    ) -> "BinnedDataset":
         """Quantize a scipy CSR/CSC matrix without densifying the raw
         values: bin mappers come from per-column stored values (+ implicit
         zero counts), and only the uint8/16 bin matrix is materialized —
@@ -219,7 +223,8 @@ class BinnedDataset:
                     f"features")
             all_mappers = mappers
         used, used_mappers, dtype = _select_used_features(
-            all_mappers, feature_pre_filter and mappers is None)
+            all_mappers, feature_pre_filter and
+            (mappers is None or pre_filter_with_mappers))
         binned = np.empty((num_data, len(used)), dtype=dtype)
         indptr, indices, vals = X.indptr, X.indices, X.data
         for j, f in enumerate(used):

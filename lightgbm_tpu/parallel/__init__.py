@@ -1,4 +1,4 @@
 from .comm import CommSpec
-from .mesh import default_mesh, make_mesh
+from .mesh import default_mesh, make_mesh, setup_multihost
 
-__all__ = ["CommSpec", "make_mesh", "default_mesh"]
+__all__ = ["CommSpec", "make_mesh", "default_mesh", "setup_multihost"]

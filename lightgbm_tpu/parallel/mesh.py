@@ -17,7 +17,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 __all__ = ["make_mesh", "default_mesh", "init_distributed",
-           "provision_virtual_devices"]
+           "provision_virtual_devices", "setup_multihost"]
 
 
 def provision_virtual_devices(n_devices: int) -> None:
@@ -81,3 +81,88 @@ def init_distributed(coordinator_address: Optional[str] = None,
     if coordinator_address is not None:
         jax.distributed.initialize(coordinator_address, num_processes,
                                    process_id)
+
+
+def _local_addresses() -> set:
+    import socket
+    addrs = {"127.0.0.1", "localhost", "0.0.0.0"}
+    try:
+        host = socket.gethostname()
+        addrs.add(host)
+        for ip in socket.gethostbyname_ex(host)[2]:
+            addrs.add(ip)
+    except OSError:
+        pass
+    return addrs
+
+
+def setup_multihost(num_machines: int, machines: str = "",
+                    machine_list_filename: str = "",
+                    local_listen_port: int = 12400) -> None:
+    """Join a multi-machine training group from the reference's network
+    config surface (config.h: machines / machine_list_filename /
+    local_listen_port / num_machines; Network::Init + linkers_socket.cpp
+    machine-list parsing). The TPU equivalent is a jax.distributed
+    rendezvous over DCN: machine 0's entry is the coordinator, each
+    process finds its rank by matching its local addresses + listen port
+    in the list (override with env LIGHTGBM_TPU_MACHINE_RANK). After
+    this, jax.devices() is the GLOBAL device set and the mesh/shard_map
+    collectives span all hosts."""
+    import os
+
+    # NOTE: jax.process_count() would itself initialize the backend;
+    # consult the distributed client state directly instead
+    try:
+        from jax._src.distributed import global_state as _dstate
+        if _dstate.client is not None:
+            return  # rendezvous already done (e.g. by the launcher)
+    except ImportError:
+        pass
+    try:
+        from jax._src import xla_bridge as _xb
+        if _xb.backends_are_initialized():
+            raise RuntimeError(
+                "multi-machine setup must run before any JAX backend use "
+                "(the reference calls Network::Init before loading data, "
+                "application.cpp:165). Call "
+                "lightgbm_tpu.setup_multihost(...) at program start, "
+                "before constructing Datasets or Boosters.")
+    except ImportError:
+        pass
+    entries = []
+    if machines:
+        for item in machines.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            host, _, port = item.rpartition(":")
+            entries.append((host, int(port)))
+    elif machine_list_filename:
+        with open(machine_list_filename) as fh:
+            for line in fh:
+                parts = line.split()
+                if len(parts) >= 2:
+                    entries.append((parts[0], int(parts[1])))
+    if not entries:
+        raise ValueError(
+            "num_machines > 1 requires `machines` (host:port,...) or "
+            "machine_list_filename (reference config.h machine list)")
+    if len(entries) != num_machines:
+        raise ValueError(
+            f"machine list has {len(entries)} entries but "
+            f"num_machines={num_machines}")
+    rank_env = os.environ.get("LIGHTGBM_TPU_MACHINE_RANK")
+    if rank_env is not None:
+        rank = int(rank_env)
+    else:
+        local = _local_addresses()
+        matches = [i for i, (h, p) in enumerate(entries)
+                   if h in local and p == local_listen_port]
+        if len(matches) != 1:
+            raise ValueError(
+                "could not determine this machine's rank from the "
+                "machine list (matched %d entries); set "
+                "LIGHTGBM_TPU_MACHINE_RANK" % len(matches))
+        rank = matches[0]
+    coordinator = f"{entries[0][0]}:{entries[0][1]}"
+    jax.distributed.initialize(coordinator, num_machines, rank)

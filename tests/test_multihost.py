@@ -1,0 +1,122 @@
+"""Two-process localhost multi-machine training (reference
+tests/distributed/_test_distributed.py: N CLI processes over loopback
+sockets; here N python processes joined by jax.distributed, each holding
+its row partition, with histogram psums spanning both)."""
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # spawns processes, compiles twice
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, {repo!r})
+    rank = int(os.environ["LIGHTGBM_TPU_MACHINE_RANK"])
+    ports = os.environ["TEST_PORTS"].split(",")
+    import lightgbm_tpu as lgb
+    # network init BEFORE any data/backend work, like the reference CLI
+    lgb.setup_multihost(
+        2, ",".join(f"127.0.0.1:{{p}}" for p in ports),
+        local_listen_port=int(ports[rank]))
+    from conftest_data import make_data
+    X, y = make_data()
+    n_half = len(y) // 2
+    sl = slice(0, n_half) if rank == 0 else slice(n_half, None)
+    params = dict(objective="binary", tree_learner="data",
+                  num_machines=2,
+                  machines=",".join(f"127.0.0.1:{{p}}" for p in ports),
+                  local_listen_port=int(ports[rank]),
+                  num_leaves=15, verbosity=-1, min_data_in_leaf=20,
+                  boost_from_average=False)
+    bst = lgb.train(params, lgb.Dataset(X[sl], label=y[sl]), 5)
+    bst.save_model(os.environ["TEST_OUT"])
+""")
+
+_DATA_MOD = textwrap.dedent("""
+    import numpy as np
+    def make_data(n=4096, f=8, seed=3):
+        r = np.random.RandomState(seed)
+        X = r.randn(n, f)
+        logit = X[:, 0] * 1.5 + 0.5 * X[:, 1] ** 2 - X[:, 2] + \\
+            0.3 * r.randn(n)
+        y = (logit > np.median(logit)).astype(np.float32)
+        return X, y
+""")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_matches_single_process(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    (tmp_path / "conftest_data.py").write_text(_DATA_MOD)
+    (tmp_path / "worker.py").write_text(_WORKER.format(repo=repo))
+    ports = [str(_free_port()), str(_free_port())]
+    procs = []
+    outs = []
+    for rank in range(2):
+        out = tmp_path / f"model_{rank}.txt"
+        outs.append(out)
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=4",
+                   LIGHTGBM_TPU_MACHINE_RANK=str(rank),
+                   TEST_PORTS=",".join(ports),
+                   TEST_OUT=str(out),
+                   PYTHONPATH=str(tmp_path))
+        # a site hook in some environments initializes the JAX backend at
+        # interpreter start, which forbids jax.distributed.initialize;
+        # drop its trigger so workers start with an untouched backend
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(tmp_path / "worker.py")], env=env,
+            cwd=str(tmp_path), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT))
+    for p in procs:
+        try:
+            out_text, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process training timed out")
+        assert p.returncode == 0, out_text.decode()[-3000:]
+
+    # both ranks hold the identical replicated model (the dumped
+    # parameters section records each rank's own listen port — the only
+    # legitimate difference)
+    def strip_port(text):
+        return "\n".join(ln for ln in text.splitlines()
+                         if "local_listen_port" not in ln)
+
+    m0 = outs[0].read_text()
+    m1 = outs[1].read_text()
+    assert strip_port(m0) == strip_port(m1)
+
+    # and it equals single-process training on the concatenated data
+    import lightgbm_tpu as lgb
+    sys.path.insert(0, str(tmp_path))
+    try:
+        from conftest_data import make_data
+    finally:
+        sys.path.pop(0)
+    X, y = make_data()
+    bst = lgb.train(dict(objective="binary", tree_learner="data",
+                         num_leaves=15, verbosity=-1, min_data_in_leaf=20,
+                         boost_from_average=False),
+                    lgb.Dataset(X, label=y), 5)
+    multi = lgb.Booster(model_str=m0)
+    np.testing.assert_allclose(multi.predict(X[:512]),
+                               bst.predict(X[:512]), rtol=1e-5, atol=1e-6)
