@@ -31,6 +31,44 @@ def _is_sparse(data) -> bool:
     return hasattr(data, "tocsc") and hasattr(data, "nnz")
 
 
+def _is_pandas_df(data) -> bool:
+    return hasattr(data, "dtypes") and hasattr(data, "columns") and \
+        hasattr(data, "select_dtypes")
+
+
+def _data_from_pandas(df, pandas_categorical=None):
+    """DataFrame -> (f64 matrix, feature names, categorical column
+    indices, pandas_categorical). Mirrors the reference's
+    _data_from_pandas (basic.py:541-624): category-dtype columns are
+    encoded as their category codes; the per-column category lists are
+    remembered (training) or applied (prediction, so codes follow the
+    TRAINING ordering regardless of the frame's own categories);
+    unseen categories / NaN become NaN."""
+    cat_cols = [str(c) for c in df.select_dtypes(
+        include=["category"]).columns]
+    names = [str(c) for c in df.columns]
+    if pandas_categorical is None:   # training
+        # .tolist() yields native python scalars so the model-file JSON
+        # round-trips int/float categories exactly (np.int64 would
+        # stringify and never match at predict time)
+        pandas_categorical = [df[c].cat.categories.tolist()
+                              for c in cat_cols]
+    else:                            # prediction with a trained model
+        if len(cat_cols) != len(pandas_categorical):
+            raise ValueError(
+                "train and valid dataset categorical_feature do not "
+                "match.")
+    df = df.copy(deep=False)
+    for col, cats in zip(cat_cols, pandas_categorical):
+        codes = df[col].cat.set_categories(cats).cat.codes
+        df[col] = np.where(codes.values < 0, np.nan,
+                           codes.values.astype(np.float64))
+    X = np.ascontiguousarray(
+        df.astype(np.float64).values, dtype=np.float64)
+    cat_idx = [names.index(c) for c in cat_cols]
+    return X, names, cat_idx, pandas_categorical
+
+
 def _to_2d_float(data) -> np.ndarray:
     if hasattr(data, "values") and not isinstance(data, np.ndarray):
         data = data.values  # pandas
@@ -170,12 +208,31 @@ class Dataset:
                 self.label, raw = raw[:, 0], raw[:, 1:]
             data = raw
         sparse_in = _is_sparse(data)
-        # sparse stays sparse through binning (reference SparseBin /
-        # __init_from_csr): only the uint8 bin matrix is densified
-        X = data if sparse_in else _to_2d_float(data)
+        pandas_cat = None
+        pandas_cat_idx: List[int] = []
+        if _is_pandas_df(data):
+            # category-dtype columns: codes + remembered category lists
+            # (reference basic.py:541-624); round-trips through the
+            # model file's pandas_categorical JSON. Valid sets encode
+            # with the TRAINING dataset's category order.
+            ref_pc = None
+            if self.reference is not None:
+                self.reference.construct()
+                ref_pc = getattr(self.reference._binned,
+                                 "pandas_categorical", None)
+            X, df_names, pandas_cat_idx, pandas_cat = \
+                _data_from_pandas(data, ref_pc)
+            names_from_df = df_names
+        else:
+            # sparse stays sparse through binning (reference SparseBin /
+            # __init_from_csr): only the uint8 bin matrix is densified
+            X = data if sparse_in else _to_2d_float(data)
+            names_from_df = None
         names: Optional[List[str]] = None
         if self.feature_name != "auto" and self.feature_name is not None:
             names = list(self.feature_name)
+        elif names_from_df is not None:
+            names = names_from_df
         elif hasattr(self.data, "columns"):
             names = [str(c) for c in self.data.columns]
         cat: List[int] = []
@@ -189,6 +246,8 @@ class Dataset:
         elif cfg.categorical_feature:
             cat = [int(c) for c in str(cfg.categorical_feature).split(",")
                    if c != ""]
+        elif pandas_cat_idx:
+            cat = list(pandas_cat_idx)  # 'auto': category-dtype columns
         construct_binned = (BinnedDataset.from_sparse if sparse_in
                             else BinnedDataset.from_raw)
         label = None if self.label is None else \
@@ -237,6 +296,7 @@ class Dataset:
                 feature_pre_filter=cfg.feature_pre_filter,
                 keep_raw=cfg.linear_tree, mappers=dist_mappers,
                 pre_filter_with_mappers=dist_mappers is not None)
+        self._binned.pandas_categorical = pandas_cat
         if self.free_raw_data:
             self.data = None
         return self
@@ -312,6 +372,8 @@ class Dataset:
         self.construct()
         sub = Dataset(None, params=params or self.params)
         sub._binned = self._binned.subset(np.asarray(used_indices))
+        sub._binned.pandas_categorical = getattr(
+            self._binned, "pandas_categorical", None)
         sub.reference = self
         return sub
 
@@ -327,13 +389,24 @@ class Dataset:
 
     def set_categorical_feature(self, categorical_feature) -> "Dataset":
         """Change the categorical features (reference basic.py
-        set_categorical_feature); only allowed before construction."""
+        set_categorical_feature, :2092-2100): after construction the
+        binned data is dropped and lazily rebuilt — possible only while
+        the raw data is retained (free_raw_data=False)."""
         if self.categorical_feature == categorical_feature:
             return self
         if self._binned is not None:
-            raise LightGBMError(
-                "set_categorical_feature after Dataset construction "
-                "requires reconstructing; create a new Dataset instead")
+            if self.data is None:
+                raise LightGBMError(
+                    "Cannot set categorical feature after freed raw "
+                    "data, set free_raw_data=False when construct "
+                    "Dataset to avoid this.")
+            from .utils.log import Log
+            Log.warning("categorical_feature in Dataset is overridden.\n"
+                        "New categorical_feature is %s",
+                        sorted(list(categorical_feature))
+                        if not isinstance(categorical_feature, str)
+                        else categorical_feature)
+            self._binned = None  # lazily re-constructed with the new set
         self.categorical_feature = categorical_feature
         return self
 
@@ -552,6 +625,11 @@ class Booster:
                   pred_early_stop=pred_early_stop,
                   pred_early_stop_freq=pred_early_stop_freq,
                   pred_early_stop_margin=pred_early_stop_margin)
+        if _is_pandas_df(data) and model.pandas_categorical is not None:
+            # encode category columns with the TRAINING category order
+            # (reference basic.py predict-time _data_from_pandas)
+            data, _, _, _ = _data_from_pandas(
+                data, model.pandas_categorical)
         if _is_sparse(data):
             # densify in row chunks so wide-sparse inputs never need the
             # full dense matrix in memory (reference predicts CSR rows

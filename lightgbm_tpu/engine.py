@@ -254,9 +254,11 @@ def _make_n_folds(full_data: Dataset, folds, nfold: int, params,
                      for k in range(nfold)]
     ret = []
     for train_idx, test_idx in folds:
-        train_sub = full_data.subset(sorted(train_idx), params)
-        valid_sub = full_data.subset(sorted(test_idx), params)
-        ret.append((train_sub, valid_sub))
+        tr = np.sort(np.asarray(train_idx))
+        te = np.sort(np.asarray(test_idx))
+        train_sub = full_data.subset(tr, params)
+        valid_sub = full_data.subset(te, params)
+        ret.append((train_sub, valid_sub, tr, te))
     return ret
 
 
@@ -281,11 +283,29 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
        return_cvbooster: bool = False) -> Dict[str, List[float]]:
     params = copy.deepcopy(params or {})
     num_boost_round = _resolve_num_boost_round(params, num_boost_round)
+    init_full = None
     if init_model is not None:
-        # fail loudly rather than silently ignoring the base model
-        raise NotImplementedError(
-            "cv() does not support init_model continuation yet; "
-            "use train(init_model=...) per fold")
+        # continuation: the base model's raw predictions seed every
+        # fold's init scores (reference cv: train_set._set_predictor,
+        # engine.py:548-562)
+        base_model = init_model if isinstance(init_model, Booster) else \
+            Booster(model_file=init_model)
+        if train_set.data is None or isinstance(train_set.data, str):
+            raise ValueError(
+                "cv(init_model=...) needs in-memory raw data on the "
+                "dataset; pass free_raw_data=False with an array/frame "
+                "(file-backed Datasets are not supported here)")
+        existing = train_set.init_score
+        if existing is None and train_set._binned is not None:
+            existing = train_set._binned.metadata.init_score
+        if existing is not None:
+            # same contract as train(): base trees' predictions become
+            # the init scores, so a user init_score would double-count
+            raise ValueError(
+                "cannot combine init_model with a dataset that already "
+                "has init_score")
+        init_full = np.asarray(
+            base_model.predict(train_set.data, raw_score=True), np.float64)
     if fobj is not None:
         params["objective"] = "none"
     if metrics:
@@ -299,7 +319,14 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
                             stratified, shuffle)
     cvbooster = CVBooster()
     boosters = []
-    for train_sub, valid_sub in cvfolds:
+    for train_sub, valid_sub, tr_idx, te_idx in cvfolds:
+        if init_full is not None:
+            # subsets are already constructed; push into binned metadata
+            # (the path Booster reads init scores from)
+            for sub, idx in ((train_sub, tr_idx), (valid_sub, te_idx)):
+                sub.init_score = init_full[idx]
+                sub._binned.metadata.init_score = np.ascontiguousarray(
+                    init_full[idx], np.float64)
         if fpreproc is not None:
             train_sub, valid_sub, params = fpreproc(
                 train_sub, valid_sub, params.copy())

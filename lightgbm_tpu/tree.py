@@ -316,6 +316,9 @@ class HostModel:
         self.feature_names: List[str] = []
         self.feature_infos: List[str] = []
         self.params: Dict[str, str] = {}
+        # training-time pandas category lists per categorical column
+        # (reference basic.py pandas_categorical round-trip)
+        self.pandas_categorical = None
 
     @property
     def num_iterations(self) -> int:
@@ -409,6 +412,8 @@ class HostModel:
             model.max_feature_idx = ds.num_total_features - 1
             model.feature_names = list(ds.feature_names)
             model.feature_infos = _feature_infos(ds)
+            model.pandas_categorical = getattr(ds, "pandas_categorical",
+                                               None)
             used_to_orig = np.asarray(ds.used_features, np.int64)
             mappers = ds.mappers
         else:
@@ -453,8 +458,10 @@ class HostModel:
             return out
         if pred_contrib:
             if any(t.is_linear for t in self.trees):
+                # reference parity: predictor.hpp:90 Log::Fatal
                 raise NotImplementedError(
-                    "pred_contrib is not supported for linear-tree models")
+                    "Predicting SHAP feature contributions is not "
+                    "implemented for linear trees.")
             return self.predict_contrib(X, start_iteration, end_iteration)
         out = np.zeros((n, k), np.float64)
         # margin-based prediction early stop (reference
@@ -658,6 +665,12 @@ class HostModel:
         if tree_strs:
             body += "\n"
         body += "end of trees\n"
+        # pandas category lists (reference gbdt_model_text via python
+        # basic.py:591-624: the file remembers training-time category
+        # orderings so DataFrame prediction encodes identically)
+        import json as _json
+        body += "\npandas_categorical:%s\n" % _json.dumps(
+            self.pandas_categorical, default=str)
         imp = self.feature_importance("split")
         pairs = sorted(
             [(int(imp[i]), self.feature_names[i])
@@ -731,6 +744,13 @@ class HostModel:
             model.trees.append(HostTree.from_block(kv))
         k = max(model.num_tree_per_iteration, 1)
         model.tree_class = [ti % k for ti in range(len(model.trees))]
+        if "pandas_categorical:" in s:
+            import json as _json
+            pline = s.split("pandas_categorical:", 1)[1].split("\n", 1)[0]
+            try:
+                model.pandas_categorical = _json.loads(pline)
+            except ValueError:
+                model.pandas_categorical = None
         # parameters tail (optional)
         if "parameters:" in s:
             tail = s.split("parameters:", 1)[1]

@@ -358,3 +358,121 @@ class TestSparseInput:
         dense_contrib = bst.predict(Xd[:100], pred_contrib=True)
         np.testing.assert_allclose(np.asarray(contrib.todense()),
                                    dense_contrib, rtol=1e-5, atol=1e-6)
+
+
+class TestPandasCategorical:
+    """pandas categorical-dtype handling + model-file round-trip
+    (reference basic.py:541-624 _data_from_pandas, pandas_categorical
+    JSON in the model text)."""
+
+    @staticmethod
+    def _frame(n=2000, seed=0):
+        pd = pytest.importorskip("pandas")
+        r = np.random.RandomState(seed)
+        cats = ["red", "green", "blue", "violet"]
+        df = pd.DataFrame({
+            "x0": r.randn(n),
+            "color": pd.Categorical(r.choice(cats, n), categories=cats),
+            "x2": r.randn(n),
+        })
+        y = ((df["color"].cat.codes.values % 2 == 0) &
+             (df["x0"].values > 0)).astype(np.float32)
+        return df, y
+
+    def test_auto_categorical_and_roundtrip(self, tmp_path):
+        df, y = self._frame()
+        bst = lgb.train({"objective": "binary", "verbosity": -1,
+                         "num_leaves": 15, "min_data_in_leaf": 5},
+                        lgb.Dataset(df, label=y), 15)
+        pred = bst.predict(df)
+        assert ((pred > 0.5) == y).mean() > 0.95
+        # model file stores the category lists; a reloaded model maps a
+        # REORDERED categorical frame identically
+        path = tmp_path / "m.txt"
+        bst.save_model(str(path))
+        assert "pandas_categorical:" in path.read_text()
+        bst2 = lgb.Booster(model_file=str(path))
+        pd = pytest.importorskip("pandas")
+        df_re = df.copy()
+        df_re["color"] = df_re["color"].cat.set_categories(
+            ["violet", "blue", "green", "red"])
+        np.testing.assert_allclose(bst2.predict(df_re), pred,
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_unseen_category_routes_default(self):
+        df, y = self._frame(seed=1)
+        bst = lgb.train({"objective": "binary", "verbosity": -1,
+                         "num_leaves": 15, "min_data_in_leaf": 5},
+                        lgb.Dataset(df, label=y), 8)
+        pd = pytest.importorskip("pandas")
+        df2 = df.head(50).copy()
+        df2["color"] = pd.Categorical(["ultraviolet"] * 50)
+        out = bst.predict(df2)  # unseen category -> NaN -> default path
+        assert np.all(np.isfinite(out))
+
+
+class TestSetCategoricalAfterConstruct:
+    def test_reconstructs_when_raw_kept(self):
+        r = np.random.RandomState(0)
+        X = r.randn(1500, 4)
+        X[:, 1] = r.randint(0, 6, 1500)
+        y = (X[:, 0] > 0).astype(np.float32)
+        d = lgb.Dataset(X, label=y, free_raw_data=False)
+        d.construct()
+        d.set_categorical_feature([1])  # drops + lazily rebuilds
+        d.construct()
+        assert bool(d._binned.is_categorical[
+            list(d._binned.used_features).index(1)])
+
+    def test_raises_when_raw_freed(self):
+        r = np.random.RandomState(0)
+        X = r.randn(500, 3)
+        y = (X[:, 0] > 0).astype(np.float32)
+        d = lgb.Dataset(X, label=y)
+        d.construct()
+        with pytest.raises(lgb.LightGBMError, match="free_raw_data"):
+            d.set_categorical_feature([1])
+
+
+def test_valid_set_uses_training_category_order():
+    pd = pytest.importorskip("pandas")
+    df, y = TestPandasCategorical._frame(seed=2)
+    dtrain = lgb.Dataset(df, label=y)
+    # valid frame with the same values but a REORDERED category dtype
+    df_val = df.head(400).copy()
+    df_val["color"] = df_val["color"].cat.set_categories(
+        ["violet", "blue", "green", "red"])
+    evals = {}
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 15, "min_data_in_leaf": 5,
+                     "metric": "binary_logloss"},
+                    dtrain, 10,
+                    valid_sets=[dtrain.create_valid(
+                        df_val, label=y[:400])],
+                    callbacks=[lgb.record_evaluation(evals)])
+    # the valid rows are a subset of train rows: with correct
+    # encoding the valid logloss tracks the train fit closely
+    key = list(evals.values())[0]["binary_logloss"]
+    pred = bst.predict(df.head(400))
+    assert ((pred > 0.5) == y[:400]).mean() > 0.95
+    assert key[-1] < 0.45
+
+def test_int_categories_survive_save_load(tmp_path):
+    pd = pytest.importorskip("pandas")
+    r = np.random.RandomState(4)
+    n = 1500
+    df = pd.DataFrame({
+        "x0": r.randn(n),
+        "code": pd.Categorical(r.choice([3, 5, 11, 42], n)),
+    })
+    y = ((df["code"].values.astype(int) > 4) &
+         (df["x0"].values > 0)).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 15, "min_data_in_leaf": 5},
+                    lgb.Dataset(df, label=y), 10)
+    path = tmp_path / "m.txt"
+    bst.save_model(str(path))
+    bst2 = lgb.Booster(model_file=str(path))
+    np.testing.assert_allclose(bst2.predict(df), bst.predict(df),
+                               rtol=1e-6, atol=1e-7)
+    assert ((bst2.predict(df) > 0.5) == y).mean() > 0.9

@@ -407,3 +407,35 @@ class TestCategorical:
         bst2 = lgb.Booster(model_str=s)
         np.testing.assert_allclose(bst.predict(X), bst2.predict(X),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestCVInitModel:
+    def test_cv_continues_from_base_model(self):
+        from conftest import make_binary
+        X, y = make_binary(n=3000, f=8)
+        base = lgb.train({"objective": "binary", "verbosity": -1,
+                          "num_leaves": 15}, lgb.Dataset(X, label=y), 10)
+        res = lgb.cv({"objective": "binary", "verbosity": -1,
+                      "num_leaves": 15},
+                     lgb.Dataset(X, label=y, free_raw_data=False),
+                     num_boost_round=5, nfold=3, init_model=base)
+        key = [k for k in res if k.endswith("-mean")][0]
+        cold = lgb.cv({"objective": "binary", "verbosity": -1,
+                       "num_leaves": 15},
+                      lgb.Dataset(X, label=y, free_raw_data=False),
+                      num_boost_round=5, nfold=3)
+        # continuation starts from the base model's fit: first-round
+        # metric must beat the cold start's
+        assert res[key][0] < cold[key][0]
+
+    def test_cv_init_model_requires_raw(self):
+        from conftest import make_binary
+        X, y = make_binary(n=1000, f=5)
+        base = lgb.train({"objective": "binary", "verbosity": -1},
+                         lgb.Dataset(X, label=y), 3)
+        d = lgb.Dataset(X, label=y)
+        d.construct()
+        d.data = None
+        with pytest.raises(ValueError, match="raw data"):
+            lgb.cv({"objective": "binary", "verbosity": -1}, d,
+                   num_boost_round=2, nfold=2, init_model=base)
