@@ -16,6 +16,12 @@
   .lgb_env$mod
 }
 
+# reticulate converts an unnamed empty R list to a Python list; the core
+# expects a dict of parameters
+.lgb_params <- function(params) {
+  if (length(params) == 0L) reticulate::dict() else params
+}
+
 #' Construct a Dataset (reference lgb.Dataset, R-package/R/lgb.Dataset.R)
 #' @export
 lgb.Dataset <- function(data, params = list(), reference = NULL,
@@ -25,7 +31,7 @@ lgb.Dataset <- function(data, params = list(), reference = NULL,
   py <- .lgb_py()
   ds <- py$Dataset(
     data = data, label = label, weight = weight, group = group,
-    init_score = init_score, params = params,
+    init_score = init_score, params = .lgb_params(params),
     feature_name = if (is.null(colnames)) "auto" else as.list(colnames),
     categorical_feature = if (is.null(categorical_feature)) "auto"
                           else as.list(categorical_feature),
@@ -99,13 +105,24 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
   if (!is.null(early_stopping_rounds)) {
     params$early_stopping_round <- early_stopping_rounds
   }
+  if (is.null(params$verbosity)) {
+    params$verbosity <- as.integer(verbose)
+  }
+  cbs <- callbacks
   evals_result <- reticulate::dict()
+  if (isTRUE(record)) {
+    cbs <- c(list(py$record_evaluation(evals_result)), cbs)
+  }
+  if (length(valids) && verbose > 0L && eval_freq > 0L) {
+    cbs <- c(list(py$log_evaluation(period = as.integer(eval_freq))), cbs)
+  }
   bst <- py$train(
-    params = params, train_set = data, num_boost_round = as.integer(nrounds),
+    params = .lgb_params(params), train_set = data,
+    num_boost_round = as.integer(nrounds),
     valid_sets = unname(valids),
     valid_names = if (length(valids)) as.list(names(valids)) else NULL,
     fobj = obj, feval = eval, init_model = init_model,
-    callbacks = c(list(py$record_evaluation(evals_result)), callbacks))
+    callbacks = cbs)
   attr(bst, "evals_result") <- evals_result
   class(bst) <- c("lgb.Booster", class(bst))
   bst
@@ -120,7 +137,7 @@ lgb.cv <- function(params = list(), data, nrounds = 100L, nfold = 3L,
   if (!is.null(early_stopping_rounds)) {
     params$early_stopping_round <- early_stopping_rounds
   }
-  py$cv(params = params, train_set = data,
+  py$cv(params = .lgb_params(params), train_set = data,
         num_boost_round = as.integer(nrounds), nfold = as.integer(nfold),
         stratified = stratified, fobj = obj, feval = eval)
 }

@@ -1,0 +1,9 @@
+# Reference R-package/tests/testthat.R analog: run with
+#   Rscript R-package/tests/testthat.R
+# (needs R + reticulate pointed at a python with lightgbm_tpu).
+library(testthat)
+source(file.path(dirname(dirname(sys.frame(1)$ofile %||% "R-package/tests")),
+                 "R", "lightgbm.R"))
+`%||%` <- function(a, b) if (is.null(a)) b else a
+test_dir(file.path(dirname(sys.frame(1)$ofile %||% "R-package/tests"),
+                   "testthat"))

@@ -1,0 +1,65 @@
+# Smoke tests for the reticulate bridge (reference R-package/tests/
+# testthat/test_basic.R, condensed): Dataset/train/predict/save/load/
+# importance/eval-results on a toy binary problem.
+
+test_that("train, predict, save and reload round-trip", {
+  set.seed(1)
+  n <- 800L
+  X <- matrix(rnorm(n * 5L), ncol = 5L)
+  y <- as.numeric(X[, 1L] + 0.5 * X[, 2L] > 0)
+  dtrain <- lgb.Dataset(X, label = y)
+  bst <- lgb.train(params = list(objective = "binary", verbosity = -1L,
+                                 num_leaves = 15L),
+                   data = dtrain, nrounds = 10L, verbose = 0L)
+  p <- predict.lgb.Booster(bst, X)
+  expect_equal(length(p), n)
+  expect_gt(mean((p > 0.5) == y), 0.9)
+
+  f <- tempfile(fileext = ".txt")
+  lgb.save(bst, f)
+  bst2 <- lgb.load(filename = f)
+  p2 <- predict.lgb.Booster(bst2, X)
+  expect_equal(p, p2, tolerance = 1e-7)
+})
+
+test_that("empty params list works (dict conversion)", {
+  set.seed(2)
+  X <- matrix(rnorm(600L), ncol = 3L)
+  y <- rnorm(200L)
+  dtrain <- lgb.Dataset(X, label = y)
+  expect_silent({
+    bst <- lgb.train(data = dtrain, nrounds = 3L, verbose = 0L)
+  })
+})
+
+test_that("valids + record produce eval results", {
+  set.seed(3)
+  X <- matrix(rnorm(2000L), ncol = 4L)
+  y <- as.numeric(X[, 1L] > 0)
+  dtrain <- lgb.Dataset(X, label = y,
+                        params = list(objective = "binary"))
+  dvalid <- lgb.Dataset.create.valid(dtrain, X[1:100L, ], label = y[1:100L])
+  bst <- lgb.train(params = list(objective = "binary", verbosity = -1L,
+                                 metric = "binary_logloss"),
+                   data = dtrain, nrounds = 5L,
+                   valids = list(valid = dvalid), verbose = 0L)
+  r <- lgb.get.eval.result(bst, "valid", "binary_logloss")
+  expect_equal(length(r), 5L)
+  expect_true(all(diff(r) <= 1e-6))
+})
+
+test_that("importance and cv run", {
+  set.seed(4)
+  X <- matrix(rnorm(1500L), ncol = 5L)
+  y <- as.numeric(X[, 1L] > 0)
+  dtrain <- lgb.Dataset(X, label = y)
+  bst <- lgb.train(params = list(objective = "binary", verbosity = -1L),
+                   data = dtrain, nrounds = 5L, verbose = 0L)
+  imp <- lgb.importance(bst)
+  expect_true(is.data.frame(imp))
+  expect_equal(nrow(imp), 5L)
+  cvres <- lgb.cv(params = list(objective = "binary", verbosity = -1L),
+                  data = lgb.Dataset(X, label = y), nrounds = 3L,
+                  nfold = 2L)
+  expect_true(length(cvres) > 0L)
+})

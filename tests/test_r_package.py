@@ -1,0 +1,20 @@
+"""pytest-driven smoke run of the R bridge's testthat suite (reference
+R-package/tests/). Skips when no R interpreter (this CI image has
+none); run on a machine with R + reticulate to validate the bridge."""
+
+import shutil
+import subprocess
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def test_r_testthat_suite():
+    rscript = shutil.which("Rscript")
+    if rscript is None:
+        pytest.skip("Rscript not available in this image")
+    proc = subprocess.run(
+        [rscript, "R-package/tests/testthat.R"], cwd="/root/repo",
+        capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
