@@ -1,9 +1,14 @@
-# Reference R-package/tests/testthat.R analog: run with
-#   Rscript R-package/tests/testthat.R
+# Run with:  Rscript R-package/tests/testthat.R
 # (needs R + reticulate pointed at a python with lightgbm_tpu).
 library(testthat)
-source(file.path(dirname(dirname(sys.frame(1)$ofile %||% "R-package/tests")),
-                 "R", "lightgbm.R"))
+
 `%||%` <- function(a, b) if (is.null(a)) b else a
-test_dir(file.path(dirname(sys.frame(1)$ofile %||% "R-package/tests"),
-                   "testthat"))
+
+args <- commandArgs(trailingOnly = FALSE)
+file_arg <- sub("^--file=", "", grep("^--file=", args, value = TRUE))
+if (length(file_arg) == 0L) {
+  stop("run via Rscript R-package/tests/testthat.R")
+}
+repo_root <- normalizePath(file.path(dirname(file_arg), "..", ".."))
+source(file.path(repo_root, "R-package", "R", "lightgbm.R"))
+test_dir(file.path(repo_root, "R-package", "tests", "testthat"))

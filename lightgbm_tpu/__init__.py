@@ -13,7 +13,7 @@ from .parallel import setup_multihost
 from .utils.log import LightGBMError, register_logger
 
 try:  # user-facing API (available once all layers are built)
-    from .basic import Booster, Dataset
+    from .basic import Booster, Dataset, Sequence
     from .callback import (early_stopping, log_evaluation,
                            record_evaluation, reset_parameter)
     from .engine import cv, train
@@ -21,7 +21,7 @@ try:  # user-facing API (available once all layers are built)
 except ImportError:  # pragma: no cover - during partial builds only
     pass
 
-__all__ = ["Dataset", "Booster", "train", "cv", "Config", "LightGBMError",
+__all__ = ["Dataset", "Booster", "Sequence", "train", "cv", "Config", "LightGBMError",
            "register_logger", "early_stopping", "log_evaluation",
            "record_evaluation", "reset_parameter", "plot_importance",
            "plot_metric", "plot_tree", "setup_multihost", "__version__"]

@@ -12,7 +12,8 @@ from __future__ import annotations
 
 import io
 import json
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, Dict, List, Optional, Union
+from typing import Sequence as TypingSequence
 
 import numpy as np
 
@@ -22,8 +23,38 @@ from .data import BinnedDataset, Metadata
 from .metrics import METRIC_ALIASES, create_metric
 from .objectives import create_objective
 from .utils.log import Log, LightGBMError
+from .utils.file_io import open_file
 
 __all__ = ["Dataset", "Booster", "LightGBMError"]
+
+
+class Sequence:
+    """Generic row-chunk provider for streamed Dataset construction
+    (reference lightgbm.Sequence, basic.py; the C path is ChunkedArray +
+    LGBM_DatasetPushRows). Subclasses implement __len__ and
+    __getitem__ supporting slices returning 2-D row blocks; batch_size
+    bounds how many rows are materialized at once."""
+
+    batch_size = 4096
+
+    def __len__(self):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def __getitem__(self, idx):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+def _is_chunked(data) -> bool:
+    """list of row chunks (2-D arrays / Sequences) or a single Sequence:
+    the streamed construction path."""
+    if isinstance(data, Sequence):
+        return True
+    if isinstance(data, list) and data and not isinstance(data[0], list):
+        return all(
+            isinstance(c, Sequence) or
+            (hasattr(c, "ndim") and getattr(c, "ndim", 0) == 2)
+            for c in data)
+    return False
 
 
 def _is_sparse(data) -> bool:
@@ -85,10 +116,11 @@ def _to_2d_float(data) -> np.ndarray:
 def _load_svmlight_or_csv(path: str) -> np.ndarray:
     """Minimal text loader: CSV/TSV with optional label in first column.
     (Reference Parser auto-detect, src/io/parser.cpp.)"""
-    with open(path) as fh:
+    with open_file(path) as fh:
         first = fh.readline()
     delim = "\t" if "\t" in first else ","
-    return np.loadtxt(path, delimiter=delim)
+    with open_file(path) as fh:
+        return np.loadtxt(fh, delimiter=delim)
 
 
 def _distributed_bin_mappers(X, cfg, cat, sparse_in):
@@ -103,6 +135,11 @@ def _distributed_bin_mappers(X, cfg, cat, sparse_in):
             return None
     except RuntimeError:
         return None
+    if not (hasattr(X, "shape") or _is_sparse(X)):
+        raise NotImplementedError(
+            "multi-machine training with chunked/Sequence input is not "
+            "supported yet (bin mappers would not be synchronized "
+            "across machines); pass an array or sparse matrix")
     from jax.experimental import multihost_utils
     from .binning import find_bin_mappers
     nproc = jax.process_count()
@@ -207,10 +244,16 @@ class Dataset:
             if self.label is None:
                 self.label, raw = raw[:, 0], raw[:, 1:]
             data = raw
-        sparse_in = _is_sparse(data)
+        chunked_in = _is_chunked(data)
+        if chunked_in:
+            data = [data] if isinstance(data, Sequence) else data
+        sparse_in = not chunked_in and _is_sparse(data)
         pandas_cat = None
         pandas_cat_idx: List[int] = []
-        if _is_pandas_df(data):
+        if chunked_in:
+            X = data  # row chunks; streamed two-pass construction
+            names_from_df = None
+        elif _is_pandas_df(data):
             # category-dtype columns: codes + remembered category lists
             # (reference basic.py:541-624); round-trips through the
             # model file's pandas_categorical JSON. Valid sets encode
@@ -248,11 +291,14 @@ class Dataset:
                    if c != ""]
         elif pandas_cat_idx:
             cat = list(pandas_cat_idx)  # 'auto': category-dtype columns
-        construct_binned = (BinnedDataset.from_sparse if sparse_in
-                            else BinnedDataset.from_raw)
+        construct_binned = (
+            BinnedDataset.from_chunks if chunked_in
+            else BinnedDataset.from_sparse if sparse_in
+            else BinnedDataset.from_raw)
+        n_rows = sum(len(c) for c in X) if chunked_in else X.shape[0]
         label = None if self.label is None else \
             np.asarray(self.label, dtype=np.float32).reshape(-1)
-        md = Metadata(X.shape[0], label=label,
+        md = Metadata(n_rows, label=label,
                       weight=None if self.weight is None else
                       np.asarray(self.weight, np.float32),
                       group=None if self.group is None else
@@ -368,7 +414,7 @@ class Dataset:
                 "group": self.get_group,
                 "init_score": self.get_init_score}[name]()
 
-    def subset(self, used_indices: Sequence[int], params=None) -> "Dataset":
+    def subset(self, used_indices: TypingSequence[int], params=None) -> "Dataset":
         self.construct()
         sub = Dataset(None, params=params or self.params)
         sub._binned = self._binned.subset(np.asarray(used_indices))
@@ -457,7 +503,7 @@ class Booster:
         self.best_score: Dict[str, Dict[str, float]] = {}
         self._train_metric_objs = []
         if model_file is not None:
-            with open(model_file) as fh:
+            with open_file(model_file) as fh:
                 model_str = fh.read()
         if model_str is not None:
             from .tree import HostModel
@@ -668,7 +714,7 @@ class Booster:
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0,
                    importance_type: str = "split") -> "Booster":
-        with open(filename, "w") as fh:
+        with open_file(filename, "w") as fh:
             fh.write(self.model_to_string(num_iteration, start_iteration,
                                           importance_type))
         return self

@@ -33,6 +33,7 @@ from ..metrics import Metric
 from ..objectives import ObjectiveFunction
 from ..utils.log import Log
 from ..utils.timer import global_timer
+from ..utils.file_io import open_file
 
 __all__ = ["GBDT", "create_boosting"]
 
@@ -235,7 +236,7 @@ class GBDT:
         if not fname:
             return None
         import json
-        with open(fname) as fh:
+        with open_file(fname) as fh:
             root = json.load(fh)
         if not root:
             return None

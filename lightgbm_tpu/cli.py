@@ -19,6 +19,7 @@ from .callback import log_evaluation
 from .config import Config, parse_config_file
 from .engine import train as train_fn
 from .utils.log import Log
+from .utils.file_io import open_file
 
 __all__ = ["main", "Application"]
 
@@ -46,7 +47,7 @@ def _load_text_data(path: str, cfg: Config):
     Reference Parser auto-detection (src/io/parser.cpp): tab/comma sniffing,
     label in column `label_column` (default 0).
     """
-    with open(path) as fh:
+    with open_file(path) as fh:
         first = fh.readline().strip()
     if ":" in first.split(" ")[-1] and "," not in first:
         # LibSVM format: label idx:val idx:val ...
@@ -54,9 +55,12 @@ def _load_text_data(path: str, cfg: Config):
     delim = "\t" if "\t" in first else ","
     skip = 1 if cfg.header else 0
     from . import cext
-    data = cext.parse_delimited(path, delim, skip)  # native parser
+    # the native parser mmaps local files; URI paths use the virtual FS
+    data = None if "://" in path else \
+        cext.parse_delimited(path, delim, skip)
     if data is None:
-        data = np.loadtxt(path, delimiter=delim, skiprows=skip, ndmin=2)
+        with open_file(path) as fh:
+            data = np.loadtxt(fh, delimiter=delim, skiprows=skip, ndmin=2)
     label_col = 0
     if cfg.label_column.startswith("name:"):
         Log.fatal("label_column=name: requires header parsing; use index")
@@ -71,7 +75,7 @@ def _load_libsvm(path: str):
     rows = []
     labels = []
     max_idx = -1
-    with open(path) as fh:
+    with open_file(path) as fh:
         for line in fh:
             parts = line.strip().split()
             if not parts:
@@ -235,7 +239,7 @@ class Application:
         booster = Booster(model_file=cfg.input_model)
         model = booster._host_model()
         code = _model_to_if_else(model)
-        with open(cfg.convert_model, "w") as fh:
+        with open_file(cfg.convert_model, "w") as fh:
             fh.write(code)
         Log.info("Model converted to %s", cfg.convert_model)
 

@@ -29,6 +29,7 @@ import numpy as np
 
 from .binning import BinMapper, find_bin_mappers
 from .utils.log import Log
+from .utils.file_io import open_file
 
 __all__ = ["Metadata", "BinnedDataset"]
 
@@ -237,6 +238,100 @@ class BinnedDataset:
         return BinnedDataset(binned, used_mappers, used, num_total,
                              metadata, feature_names, raw=None)
 
+    @staticmethod
+    def from_chunks(chunks, metadata: Metadata, max_bin: int = 255,
+                    min_data_in_bin: int = 3, sample_cnt: int = 200000,
+                    use_missing: bool = True, zero_as_missing: bool = False,
+                    categorical_features: Optional[Sequence[int]] = None,
+                    seed: int = 1,
+                    feature_names: Optional[List[str]] = None,
+                    mappers: Optional[List[BinMapper]] = None,
+                    feature_pre_filter: bool = True,
+                    keep_raw: bool = False,
+                    pre_filter_with_mappers: bool = False
+                    ) -> "BinnedDataset":
+        """Streamed construction from row chunks (a list of 2-D arrays
+        and/or Sequence objects): the reference's ChunkedArray push path
+        (utils/chunked_array.hpp, LGBM_DatasetPushRows c_api.h, python
+        Sequence in basic.py). Two passes — a global row sample finds
+        the bin mappers, then each chunk is quantized straight into the
+        preallocated uint8/16 matrix. The dense f64 matrix never exists:
+        peak host memory is one chunk + the bin matrix."""
+        if keep_raw:
+            raise ValueError(
+                "linear_tree requires an in-memory dense matrix (leaf "
+                "linear models need raw feature values)")
+        lens = [len(c) if not hasattr(c, "shape") else c.shape[0]
+                for c in chunks]
+        offsets = np.concatenate([[0], np.cumsum(lens)]).astype(np.int64)
+        num_data = int(offsets[-1])
+        if num_data == 0:
+            raise ValueError("no rows in chunks")
+        first = np.asarray(chunks[0][0:1], dtype=np.float64)
+        num_total = first.shape[1]
+
+        def chunk_rows(ci, lo, hi):
+            return np.asarray(chunks[ci][lo:hi], dtype=np.float64) \
+                .reshape(hi - lo, num_total)
+
+        if mappers is None:
+            take = min(sample_cnt, num_data)
+            rng = np.random.RandomState(seed)
+            if num_data <= take:
+                idx = np.arange(num_data)
+            elif num_data > 4 * take:
+                # huge streams: O(take) draw (choice(replace=False)
+                # would allocate an O(num_data) permutation); duplicates
+                # dropped, a slightly smaller sample is fine for binning
+                idx = np.unique(rng.randint(0, num_data, size=take))
+            else:
+                idx = np.sort(rng.choice(num_data, size=take,
+                                         replace=False))
+            parts = []
+            for ci in range(len(chunks)):
+                sel = idx[(idx >= offsets[ci]) & (idx < offsets[ci + 1])]
+                if len(sel) == 0:
+                    continue
+                local = sel - offsets[ci]
+                # batch-walk only the windows containing samples: the
+                # peak materialization stays one batch regardless of how
+                # widely the sample spans a chunk
+                step = getattr(chunks[ci], "batch_size", 65536) or 65536
+                for lo in range(0, lens[ci], step):
+                    hi = min(lo + step, lens[ci])
+                    sel_b = local[(local >= lo) & (local < hi)]
+                    if len(sel_b) == 0:
+                        continue
+                    parts.append(chunk_rows(ci, lo, hi)[sel_b - lo])
+            sample = np.concatenate(parts, axis=0)
+            all_mappers = find_bin_mappers(
+                sample, max_bin=max_bin, min_data_in_bin=min_data_in_bin,
+                sample_cnt=len(sample), use_missing=use_missing,
+                zero_as_missing=zero_as_missing,
+                categorical_features=categorical_features, seed=seed)
+        else:
+            if len(mappers) != num_total:
+                raise ValueError(
+                    f"got {len(mappers)} bin mappers for {num_total} "
+                    f"features")
+            all_mappers = mappers
+        used, used_mappers, dtype = _select_used_features(
+            all_mappers, feature_pre_filter and
+            (mappers is None or pre_filter_with_mappers))
+        binned = np.empty((num_data, len(used)), dtype=dtype)
+        for ci in range(len(chunks)):
+            step = getattr(chunks[ci], "batch_size", 65536) or 65536
+            for lo in range(0, lens[ci], step):
+                hi = min(lo + step, lens[ci])
+                block = chunk_rows(ci, lo, hi)
+                row0 = int(offsets[ci]) + lo
+                for j, f in enumerate(used):
+                    binned[row0:row0 + (hi - lo), j] = \
+                        used_mappers[j].values_to_bins(
+                            block[:, f]).astype(dtype)
+        return BinnedDataset(binned, used_mappers, used, num_total,
+                             metadata, feature_names, raw=None)
+
     # ---- accessors ----------------------------------------------------
     @property
     def num_data(self) -> int:
@@ -290,16 +385,16 @@ class BinnedDataset:
                 payload["md_" + fld] = v
         if md.query_boundaries is not None:
             payload["md_query_boundaries"] = md.query_boundaries
-        with open(filename, "wb") as fh:
+        with open_file(filename, "wb") as fh:
             np.savez_compressed(fh, **payload)
 
     @staticmethod
     def is_binary_file(filename: str) -> bool:
         try:
-            with open(filename, "rb") as fh:
+            with open_file(filename, "rb") as fh:
                 if fh.read(4) != b"PK\x03\x04":
                     return False
-            with np.load(filename) as z:
+            with open_file(filename, "rb") as fh, np.load(fh) as z:
                 if "magic" not in z:
                     return False
                 return bytes(z["magic"]).decode() == \
@@ -311,7 +406,7 @@ class BinnedDataset:
     def load_binary(filename: str) -> "BinnedDataset":
         import json
         from .binning import BinMapper
-        with np.load(filename) as z:
+        with open_file(filename, "rb") as fh, np.load(fh) as z:
             if bytes(z["magic"]).decode() != BinnedDataset._BINARY_MAGIC:
                 raise ValueError(f"{filename} is not a lightgbm_tpu "
                                  "binary dataset")

@@ -316,12 +316,20 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # block fits comfortably in VMEM, narrower for big frontiers
         return dict(row_block=2048, fchunk=7 if s <= 64 else 4)
 
-    def sweep(row_node, tbl_c, member_c, nslots):
+    def sweep(row_node, tbl_c, member_c, nslots, m_cap=None):
         """Route rows through the previous pass's packed tables and build
         the frontier histograms — fused single sweep when the histogram
         block fits VMEM, else the two-kernel fallback (wide datasets).
         Under psum_axis the local histograms are all-reduced, so the
-        subtraction/scan math downstream sees global sums."""
+        subtraction/scan math downstream sees global sums.
+
+        m_cap statically slices the node tables: pass p can only hold
+        node ids < 2*S_p, so early passes route against a 128-wide
+        one-hot instead of the full m_pad (~8x less route work for the
+        first ~6 passes of a 255-leaf tree)."""
+        if m_cap is not None and m_cap < m_pad:
+            tbl_c = tbl_c[:m_cap]
+            member_c = member_c[:m_cap]
         if fits_v2(nslots, f, bmax, hist_double_prec, quant):
             h, rn = fused_route_hist_mxu(
                 bins, h_grad, h_hess, cnt_weight, row_node, tbl_c,
@@ -339,7 +347,7 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             h = h * hist_scale  # integer sums -> gradient units
         return _allred(h), rn
 
-    def one_pass(s, st, pass_idx, k_cap=None, sk_next=None):
+    def one_pass(s, st, pass_idx, k_cap=None, sk_next=None, m_cap=None):
         """One growth pass at scan capacity `s` (python int). sk_next is
         the kernel-slot capacity of the NEXT pass (selection is throttled
         so committed splits' children fit it)."""
@@ -355,7 +363,8 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             # build only the slots assigned by the previous pass (smaller
             # siblings + both children of stale parents) ...
             sk = _kernel_cap(s)
-            kern, row_node = sweep(row_node, tbl_c, member_c, sk)
+            kern, row_node = sweep(row_node, tbl_c, member_c, sk,
+                                   m_cap=m_cap)
             # ... and reconstruct the full scan tensor [s, F, B, 3]:
             # larger sibling = parent - smaller (exact one-hot row pulls)
             npairs = (s + 1) // 2
@@ -381,7 +390,8 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 jnp.zeros((s_max, f, bmax, 3), jnp.float32), hist,
                 (0, 0, 0, 0))
         else:
-            hist, row_node = sweep(row_node, tbl_c, member_c, s)
+            hist, row_node = sweep(row_node, tbl_c, member_c, s,
+                                   m_cap=m_cap)
 
         slot_fmask = jnp.broadcast_to(feature_mask[None, :], (s, f))
         if use_bynode:
@@ -602,13 +612,14 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
     _DONE = 9  # index of the done flag in the state tuple
 
-    def cond_pass(s, st, pass_idx, k_cap=None, sk_next=None):
+    def cond_pass(s, st, pass_idx, k_cap=None, sk_next=None, m_cap=None):
         # skip whole passes once growth is done — e.g. the full-capacity
         # bridge pass after a tree that completed on schedule (a free
         # S=s_max histogram otherwise)
         return jax.lax.cond(
             st[_DONE], lambda st_: st_,
-            lambda st_: one_pass(s, st_, pass_idx, k_cap, sk_next), st)
+            lambda st_: one_pass(s, st_, pass_idx, k_cap, sk_next,
+                                 m_cap), st)
 
     # ---- unrolled doubling schedule ----
     schedule = []
@@ -617,7 +628,11 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         schedule.append(min(max(2 * s_p, 2), s_max))
         s_p *= 2
     for p, s_p in enumerate(schedule):
-        state = cond_pass(s_p, state, jnp.asarray(p, jnp.int32))
+        # pass p holds < 2*S_p node ids; slice the route tables to the
+        # lane-aligned bound (sweep docstring)
+        m_p = min(m_pad, _round_up(max(2 * s_p, 2), 128))
+        state = cond_pass(s_p, state, jnp.asarray(p, jnp.int32),
+                          m_cap=m_p)
 
     # ---- fixup loop for off-schedule leftovers ----
     # the best-first tail often splits only a couple of leaves per pass

@@ -1,0 +1,51 @@
+"""Pluggable file IO (reference VirtualFileReader/VirtualFileWriter,
+src/io/file_io.cpp + utils/file_io.h, incl. the optional HDFS backend
+behind USE_HDFS).
+
+Local paths use plain open(). URI-style paths (scheme://...) dispatch to
+a registered handler; `fsspec` is auto-used when importable (which
+covers hdfs/s3/gs/... the way the reference's HDFS build does), and
+custom schemes can be registered explicitly:
+
+    lightgbm_tpu.utils.file_io.register_filesystem("myfs", opener)
+
+where `opener(path, mode)` returns a file object. Every model-file,
+dataset-binary and CLI read/write in the package goes through
+open_file()."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+__all__ = ["open_file", "register_filesystem"]
+
+_SCHEMES: Dict[str, Callable] = {}
+
+
+def register_filesystem(scheme: str, opener: Callable) -> None:
+    """Register `opener(path, mode)` for `scheme://` paths."""
+    _SCHEMES[scheme] = opener
+
+
+def _scheme_of(path) -> str:
+    s = str(path)
+    if "://" in s:
+        return s.split("://", 1)[0]
+    return ""
+
+
+def open_file(path, mode: str = "r"):
+    """open() for local paths; registered handler or fsspec for URIs."""
+    scheme = _scheme_of(path)
+    if not scheme:
+        return open(path, mode)
+    if scheme in _SCHEMES:
+        return _SCHEMES[scheme](str(path), mode)
+    try:
+        import fsspec
+    except ImportError:
+        raise ValueError(
+            f"no filesystem registered for scheme {scheme!r} and fsspec "
+            f"is not installed; register one with "
+            f"lightgbm_tpu.utils.file_io.register_filesystem") from None
+    return fsspec.open(str(path), mode).open()

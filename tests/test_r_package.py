@@ -2,6 +2,7 @@
 R-package/tests/). Skips when no R interpreter (this CI image has
 none); run on a machine with R + reticulate to validate the bridge."""
 
+import os
 import shutil
 import subprocess
 
@@ -14,7 +15,8 @@ def test_r_testthat_suite():
     rscript = shutil.which("Rscript")
     if rscript is None:
         pytest.skip("Rscript not available in this image")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.run(
-        [rscript, "R-package/tests/testthat.R"], cwd="/root/repo",
-        capture_output=True, text=True, timeout=900)
+        [rscript, os.path.join(repo, "R-package", "tests", "testthat.R")],
+        cwd=repo, capture_output=True, text=True, timeout=900)
     assert proc.returncode == 0, proc.stdout + proc.stderr
