@@ -19,7 +19,7 @@ from .callback import log_evaluation
 from .config import Config, parse_config_file
 from .engine import train as train_fn
 from .utils.log import Log
-from .utils.file_io import open_file
+from .utils.file_io import open_file, _scheme_of
 
 __all__ = ["main", "Application"]
 
@@ -56,7 +56,7 @@ def _load_text_data(path: str, cfg: Config):
     skip = 1 if cfg.header else 0
     from . import cext
     # the native parser mmaps local files; URI paths use the virtual FS
-    data = None if "://" in path else \
+    data = None if _scheme_of(path) else \
         cext.parse_delimited(path, delim, skip)
     if data is None:
         with open_file(path) as fh:

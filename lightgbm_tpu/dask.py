@@ -1,28 +1,30 @@
-"""Dask-style distributed estimators (reference python-package/lightgbm/
-dask.py:393+ DaskLGBMClassifier/Regressor/Ranker).
+"""Dask distributed estimators (reference python-package/lightgbm/
+dask.py: DaskLGBMClassifier/Regressor/Ranker, 1572 LoC).
 
-The reference's Dask integration exists to stitch a TCP socket mesh between
-workers and run the data-parallel socket learner on each partition
-(dask.py:68-135 port probing, :167-184 machines-param injection). On TPU
-that whole transport layer is replaced by XLA collectives over ICI/DCN: a
-single process drives all local chips through `jax.sharding`
-(tree_learner=data, parallel/learner.py), and multi-host scaling uses
-`jax.distributed.initialize` + the same sharded learner instead of a Dask
-scheduler.
+The reference's integration stitches a TCP socket mesh between Dask
+workers and runs the data-parallel socket learner on each worker's
+partitions (dask.py:68-135 port probing, :167-184 machines injection,
+:393 _train, :811 _predict_part). Here the same orchestration drives the
+TPU stack: each worker joins a `jax.distributed` rendezvous
+(parallel/mesh.py setup_multihost — the Network::Init analog) and trains
+on its own partitions with tree_learner=data, histograms psum'd across
+all workers' devices; rank 0 returns the model, every rank holds an
+identical replica.
 
-These wrappers keep the reference's API shape for drop-in compatibility:
-- with dask installed, Dask collections are concatenated to the driver and
-  trained on the sharded-TPU learner (the mesh replaces worker fan-out);
-- without dask, constructing an estimator raises the same ImportError the
-  reference raises when dask is missing (dask.py:24-30).
-
-Cite: reference dask.py:393 (_train), :811 (_predict_part), :1060+
-(estimator classes).
+Caveats vs the reference, stated honestly:
+- a worker process can join a rendezvous only while its JAX backend is
+  uninitialized (jax.distributed contract), so multi-worker fit needs
+  fresh worker processes (e.g. `client.restart()` first); the reference
+  has no such constraint because its sockets are its own.
+- with no client, or a single worker, fit falls back to concatenating
+  partitions on the driver and training on the local device mesh
+  (which on TPU already provides data-parallel scaling).
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional
+import socket
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -39,12 +41,7 @@ except ImportError:
 
 
 def _concat_to_local(part):
-    """Materialize a Dask collection on the driver.
-
-    The reference trains per-worker on local partitions and relies on its
-    socket collectives for the merge; the TPU learner shards rows over the
-    device mesh instead, so data is gathered once and device-sharded
-    (parallel/learner.py 'data' mode)."""
+    """Materialize a Dask collection (or pass numpy through)."""
     import dask.array as da
     import dask.dataframe as dd
     if isinstance(part, da.Array):
@@ -52,6 +49,90 @@ def _concat_to_local(part):
     if isinstance(part, (dd.DataFrame, dd.Series)):
         return part.compute().to_numpy()
     return np.asarray(part)
+
+
+def _find_open_port() -> int:
+    """Probe a free port on this worker (reference
+    _find_random_open_port, dask.py:68)."""
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _concat_parts(parts):
+    arrs = [np.asarray(p) for p in parts]
+    return np.concatenate(arrs, axis=0) if len(arrs) > 1 else arrs[0]
+
+
+def _train_part(model_factory, params: Dict[str, Any], rank: int,
+                machines: str, num_machines: int, listen_port: int,
+                parts: List, has_weight: bool, has_group: bool,
+                fit_kwargs: Dict[str, Any], classes=None):
+    """Per-worker training body (reference _train_part, dask.py:167-184):
+    join the rendezvous, fit on the local partitions with the machines
+    params injected, return the model text from rank 0. `parts` arrives
+    as materialized (X, y[, w][, g]) tuples — dask dereferences the
+    futures placed in the submit args on the worker."""
+    import os
+
+    os.environ["LIGHTGBM_TPU_MACHINE_RANK"] = str(rank)
+    from .parallel import setup_multihost
+    setup_multihost(num_machines, machines,
+                    local_listen_port=listen_port)
+    if params.get("tree_learner") not in ("data", "voting"):
+        params = dict(params, tree_learner="data")
+    params = dict(params,
+                  num_machines=num_machines,
+                  machines=machines,
+                  local_listen_port=listen_port)
+    est = model_factory(**params)
+    if classes is not None:
+        est._classes_override = classes  # global label encoding
+    X = _concat_parts([p[0] for p in parts])
+    y = _concat_parts([p[1] for p in parts])
+    kw = dict(fit_kwargs)
+    i = 2
+    if has_weight:
+        kw["sample_weight"] = _concat_parts([p[i] for p in parts])
+        i += 1
+    if has_group:
+        kw["group"] = _concat_parts([p[i] for p in parts])
+    est.fit(X, y, **kw)
+    return est.booster_.model_to_string() if rank == 0 else None
+
+
+def _delayed_parts(coll):
+    """Aligned per-partition delayed objects of a dask collection
+    (reference _split_to_parts, dask.py:55-66)."""
+    import dask.array as da
+    d = coll.to_delayed()
+    if isinstance(coll, da.Array):
+        return list(np.asarray(d).ravel())
+    return list(d)
+
+
+def _parts_by_worker(client, collections):
+    """Future per aligned partition tuple, grouped by the worker holding
+    it (reference who_has grouping, dask.py:88-135)."""
+    import dask
+    from distributed import wait
+    part_lists = [_delayed_parts(c) for c in collections]
+    n = len(part_lists[0])
+    if any(len(pl) != n for pl in part_lists):
+        raise ValueError(
+            "X, y (and sample_weight/group) must have aligned dask "
+            "partitions")
+    tuples = [dask.delayed(tuple)(list(tup)) for tup in zip(*part_lists)]
+    futures = client.compute(tuples)
+    wait(futures)
+    who = client.who_has(futures)
+    out: Dict[str, List] = {}
+    for fut in futures:
+        w = sorted(who[fut.key])[0]
+        out.setdefault(w, []).append(fut)
+    return out
 
 
 class _DaskBase:
@@ -65,34 +146,102 @@ class _DaskBase:
                 "on TPU the device mesh already provides distributed "
                 "training (tree_learner=data)")
         self._client = client
-        params = dict(kwargs)
-        # the TPU mesh replaces the reference's per-worker socket learner
-        params.setdefault("tree_learner", "data")
-        self._local = self._local_cls(**params)
+        self._params = dict(kwargs)
+        self._params.setdefault("tree_learner", "data")
+        self._local = self._local_cls(**self._params)
 
-    # -- fit/predict keep the reference signatures (dask.py:1060+) -----
+    def _get_client(self):
+        if self._client is not None:
+            return self._client
+        try:
+            from distributed import get_client
+            return get_client()
+        except (ImportError, ValueError):
+            return None
+
+    # -- fit keeps the reference signature (dask.py:393 _train) --------
     def fit(self, X, y, sample_weight=None, group=None, **kwargs):
-        Xl = _concat_to_local(X)
-        yl = _concat_to_local(y)
-        sw = None if sample_weight is None else _concat_to_local(
-            sample_weight)
-        fit_kwargs = dict(kwargs)
-        if group is not None:
-            fit_kwargs["group"] = _concat_to_local(group)
-        self._local.fit(Xl, yl, sample_weight=sw, **fit_kwargs)
+        client = self._get_client()
+        workers = list(client.scheduler_info()["workers"]) \
+            if client is not None else []
+        if client is None or len(workers) <= 1:
+            # single worker / no scheduler: the local device mesh is the
+            # parallelism (rows shard over chips, parallel/learner.py)
+            Xl = _concat_to_local(X)
+            yl = _concat_to_local(y)
+            sw = None if sample_weight is None else _concat_to_local(
+                sample_weight)
+            fit_kwargs = dict(kwargs)
+            if group is not None:
+                fit_kwargs["group"] = _concat_to_local(group)
+            self._local.fit(Xl, yl, sample_weight=sw, **fit_kwargs)
+            return self
+
+        # ---- multi-worker: reference machines-injection flow ----------
+        colls = [X, y] + ([sample_weight] if sample_weight is not None
+                          else []) + ([group] if group is not None else [])
+        by_worker = _parts_by_worker(client, colls)
+        workers = sorted(by_worker)
+        ports = client.run(_find_open_port, workers=workers)
+        machines = ",".join(
+            f"{w.split('://')[-1].rsplit(':', 1)[0]}:{ports[w]}"
+            for w in workers)
+        classes = None
+        if isinstance(self._local, LGBMClassifier):
+            # global class set from tiny per-partition uniques (no y
+            # shipping): every rank must encode labels identically even
+            # when its partitions miss a class
+            uniq = client.gather([
+                client.submit(lambda p: np.unique(np.asarray(p[1])),
+                              f, pure=False)
+                for parts in by_worker.values() for f in parts])
+            classes = np.unique(np.concatenate(uniq))
+        futures = [
+            client.submit(
+                _train_part, type(self._local), self._params, rank,
+                machines, len(workers), ports[w], by_worker[w],
+                sample_weight is not None, group is not None,
+                dict(kwargs), classes, workers=[w], pure=False)
+            for rank, w in enumerate(workers)]
+        results = client.gather(futures)
+        model_str = next(r for r in results if r is not None)
+        from .basic import Booster
+        self._local._Booster = Booster(model_str=model_str)
+        if classes is not None:
+            self._local._classes = classes
+            self._local._n_classes = len(classes)
+            self._local._label_map = {c: i
+                                      for i, c in enumerate(classes)}
         return self
 
     def _predict_impl(self, X, method, **kwargs):
-        # partitions are scored on the driver against the local model (the
-        # reference's per-worker _predict_part, dask.py:811, exists to
-        # avoid shipping data — here the device mesh is already local).
-        # Dask collections stay dask collections so .compute() keeps
-        # working for callers written against the reference contract.
+        # per-partition scoring (reference _predict_part, dask.py:811):
+        # dask collections map the local model over their partitions so
+        # no data ships to the driver
         import dask.array as da
         import dask.dataframe as dd
-        is_dask = isinstance(X, (da.Array, dd.DataFrame, dd.Series))
-        out = np.asarray(method(_concat_to_local(X), **kwargs))
-        return da.from_array(out, chunks=out.shape) if is_dask else out
+        if isinstance(X, da.Array):
+            # probe the output rank: predict is 1-D, predict_proba /
+            # pred_contrib / multiclass raw scores are 2-D
+            probe = np.asarray(method(
+                np.zeros((1, X.shape[1]), np.float64), **kwargs))
+            fn = lambda b: np.asarray(method(b, **kwargs))
+            if probe.ndim == 1:
+                return X.map_blocks(
+                    fn, drop_axis=list(range(1, X.ndim)),
+                    dtype=probe.dtype)
+            return X.map_blocks(
+                fn, chunks=(X.chunks[0], (probe.shape[1],)),
+                dtype=probe.dtype)
+        if isinstance(X, (dd.DataFrame, dd.Series)):
+            def part_fn(p):
+                import pandas as pd
+                out = np.asarray(method(p, **kwargs))
+                if out.ndim == 1:
+                    return pd.Series(out, index=p.index)
+                return pd.DataFrame(out, index=p.index)
+            return X.map_partitions(part_fn)
+        return np.asarray(method(_concat_to_local(X), **kwargs))
 
     def predict(self, X, **kwargs):
         return self._predict_impl(X, self._local.predict, **kwargs)

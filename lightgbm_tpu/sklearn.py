@@ -255,7 +255,12 @@ class LGBMClassifier(LGBMModel):
 
     def fit(self, X, y, **kwargs):
         y_arr = np.asarray(y).reshape(-1)
-        self._classes = np.unique(y_arr)
+        # _classes_override: distributed fit (dask.py) supplies the
+        # GLOBAL class set so ranks whose partitions miss a class still
+        # encode identically
+        override = getattr(self, "_classes_override", None)
+        self._classes = np.unique(y_arr) if override is None \
+            else np.asarray(override)
         self._n_classes = len(self._classes)
         self._label_map = {c: i for i, c in enumerate(self._classes)}
         y_enc = np.asarray([self._label_map[v] for v in y_arr], np.float32)
