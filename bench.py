@@ -38,8 +38,47 @@ def make_higgs_like(n, f, seed=17):
     return X, y
 
 
+def _probe_backend(timeout_s: int = 180) -> str:
+    """Probe the accelerator in a subprocess: a wedged remote tunnel
+    hangs forever inside XLA calls, which no in-process timeout can
+    interrupt — the probe process is killable. Returns "" when healthy,
+    else a one-line diagnosis. Output goes to a temp file, not pipes:
+    a forked transport helper inheriting pipe ends would make the
+    post-kill pipe drain hang the parent — the exact failure mode the
+    probe exists to avoid."""
+    import subprocess
+    import tempfile
+    with tempfile.TemporaryFile() as errf:
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax, jax.numpy as jnp, numpy as np;"
+                 "x = jnp.ones((8, 8)) @ jnp.ones((8, 8));"
+                 "print(float(np.asarray(x)[0, 0]))"],
+                timeout=timeout_s, stdout=subprocess.DEVNULL,
+                stderr=errf, start_new_session=True)
+        except subprocess.TimeoutExpired:
+            return ("device probe timed out after %ds (wedged "
+                    "accelerator tunnel?)" % timeout_s)
+        if proc.returncode == 0:
+            return ""
+        errf.seek(0)
+        tail = errf.read().decode(errors="replace").strip()
+        return "device probe failed (rc=%d): %s" % (
+            proc.returncode, tail.splitlines()[-1] if tail else "no stderr")
+
+
 def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    problem = _probe_backend()
+    if problem:
+        # emit a parseable, honest record instead of hanging the driver
+        print(json.dumps({
+            "metric": "higgs1m_trees_per_sec", "value": 0.0,
+            "unit": "trees/sec", "vs_baseline": 0.0}))
+        print(f"# accelerator unreachable: {problem}; no measurement "
+              "possible", file=sys.stderr)
+        return
     import lightgbm_tpu as lgb
 
     X, y = make_higgs_like(N_ROWS, N_FEATURES)
