@@ -12,10 +12,23 @@ Redesign of the reference data layer (include/LightGBM/dataset.h:355
 - trivial features (single bin) are dropped up-front like the reference's
   feature_pre_filter (dataset_loader feature filtering); the used->original
   index map is kept for model output.
-- EFB bundling (feature_group.h:25) is unnecessary for dense HBM storage:
-  bundling saved *column passes* in the CPU design; the TPU scatter reads
-  every (row, feature) cell exactly once either way. Sparse-input densify
-  happens at construction.
+- EFB bundling (feature_group.h:25): for dense narrow data it buys
+  nothing on TPU (bundling saved *column passes* in the CPU design; the
+  one-hot histogram contraction reads every (row, feature) cell exactly
+  once either way), and the reference's memory headline is answered by
+  sparse ingest (from_sparse: only the uint8 bin matrix materializes).
+  For WIDE sparse data EFB would still shrink the histogram kernel's
+  F axis (its flops scale with F). The TPU-native design, sketched for
+  when that workload matters: bundle mutually-exclusive features into
+  shared uint8 columns with bin offsets (greedy conflict-bounded, as
+  the reference); build histograms on the bundled layout [S, Fb, 256];
+  run the split scan SEGMENTED — per-subfeature left sums are
+  prefix(t) - prefix(segment_start - 1) with static [Fb, 256]
+  segment-start/feature-id/NaN-position tables (all elementwise, no
+  gathers); the post-argmax (bundle, bin) -> (original feature, local
+  threshold) mapping is an [S]-sized table lookup. Growth and routing
+  stay in bundle space; the HostModel boundary unbundles exactly like
+  used_features remapping does today.
 
 `Metadata` carries label/weight/group/init_score and the query boundaries
 used by ranking objectives (reference src/io/metadata.cpp:577).
