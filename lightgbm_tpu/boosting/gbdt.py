@@ -320,7 +320,18 @@ class GBDT:
                              num_devices=ndev, top_k=cfg.top_k)
         if self.comm.mode in ("data", "voting"):
             ndev_local = max(1, ndev // self._nproc)
-            self._row_pad = (-self.num_data) % ndev_local
+            if self._nproc > 1:
+                # global shape is inferred from the local shard, so all
+                # machines pad to the LARGEST partition (padded rows
+                # carry zero grad/hess/count — they contribute nothing)
+                from jax.experimental import multihost_utils
+                sizes = np.asarray(multihost_utils.process_allgather(
+                    np.asarray(self.num_data, np.int64)))
+                target = int(-(-int(sizes.max()) // ndev_local)
+                             * ndev_local)
+                self._row_pad = target - self.num_data
+            else:
+                self._row_pad = (-self.num_data) % ndev_local
             if self._row_pad:
                 self.bins = jnp.pad(self.bins,
                                     ((0, self._row_pad), (0, 0)))
@@ -328,16 +339,6 @@ class GBDT:
                 # keep this machine's rows for local score updates /
                 # metrics (reference ranks evaluate on their partition)
                 self._local_bins = self.bins
-                # global shape is inferred from the local shard, so all
-                # machines must hold equally many (padded) rows
-                from jax.experimental import multihost_utils
-                sizes = np.asarray(multihost_utils.process_allgather(
-                    np.asarray(self.bins.shape[0], np.int64)))
-                if len(set(sizes.tolist())) != 1:
-                    raise ValueError(
-                        "multi-machine data-parallel training needs "
-                        "equal row counts per machine (got %s); pad or "
-                        "re-partition the data" % sizes.tolist())
             self.bins = self._shard_rows(self.bins)
         else:  # feature-parallel replicates rows (docs/Features.rst:109)
             self.bins = jax.device_put(

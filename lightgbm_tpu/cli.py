@@ -151,8 +151,37 @@ class Application:
         X, y = _load_text_data(cfg.data, cfg)
         group = _maybe_load_group(cfg.data)
         weight = _maybe_load_weight(cfg.data)
+        X, y, group, weight = self._partition_rows(X, y, group, weight)
         return Dataset(X, label=y, group=group, weight=weight,
                        params=dict(self.params))
+
+    def _partition_rows(self, X, y, group, weight):
+        """Multi-machine row assignment (reference
+        dataset_loader.cpp:560-592): with pre_partition=false every
+        machine reads the shared file and keeps its contiguous block —
+        query-granular when ranking groups exist, so no query spans
+        machines (dataset_loader.cpp:569-590). pre_partition=true means
+        each machine's file already IS its partition."""
+        cfg = self.config
+        if cfg.num_machines <= 1 or cfg.pre_partition:
+            return X, y, group, weight
+        import jax
+        nproc, rank = jax.process_count(), jax.process_index()
+        if nproc <= 1:
+            return X, y, group, weight
+        n = len(y)
+        if group is not None:
+            bounds = np.concatenate([[0], np.cumsum(group)])
+            qlo = len(group) * rank // nproc
+            qhi = len(group) * (rank + 1) // nproc
+            lo, hi = int(bounds[qlo]), int(bounds[qhi])
+            group = group[qlo:qhi]
+        else:
+            lo, hi = n * rank // nproc, n * (rank + 1) // nproc
+        X, y = X[lo:hi], y[lo:hi]
+        if weight is not None:
+            weight = weight[lo:hi]
+        return X, y, group, weight
 
     def train(self) -> None:
         cfg = self.config

@@ -28,8 +28,8 @@ _WORKER = textwrap.dedent("""
         local_listen_port=int(ports[rank]))
     from conftest_data import make_data
     X, y = make_data()
-    n_half = len(y) // 2
-    sl = slice(0, n_half) if rank == 0 else slice(n_half, None)
+    cut = len(y) // 2 + int(os.environ.get("TEST_UNEVEN", "0"))
+    sl = slice(0, cut) if rank == 0 else slice(cut, None)
     params = dict(objective="binary", tree_learner="data",
                   num_machines=2,
                   machines=",".join(f"127.0.0.1:{{p}}" for p in ports),
@@ -60,7 +60,8 @@ def _free_port():
     return port
 
 
-def test_two_process_matches_single_process(tmp_path):
+@pytest.mark.parametrize("uneven", [0, 17])
+def test_two_process_matches_single_process(tmp_path, uneven):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     (tmp_path / "conftest_data.py").write_text(_DATA_MOD)
     (tmp_path / "worker.py").write_text(_WORKER.format(repo=repo))
@@ -76,6 +77,7 @@ def test_two_process_matches_single_process(tmp_path):
                    LIGHTGBM_TPU_MACHINE_RANK=str(rank),
                    TEST_PORTS=",".join(ports),
                    TEST_OUT=str(out),
+                   TEST_UNEVEN=str(uneven),
                    PYTHONPATH=str(tmp_path))
         # a site hook in some environments initializes the JAX backend at
         # interpreter start, which forbids jax.distributed.initialize;
