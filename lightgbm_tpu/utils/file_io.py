@@ -48,4 +48,10 @@ def open_file(path, mode: str = "r"):
             f"no filesystem registered for scheme {scheme!r} and fsspec "
             f"is not installed; register one with "
             f"lightgbm_tpu.utils.file_io.register_filesystem") from None
-    return fsspec.open(str(path), mode).open()
+    try:
+        return fsspec.open(str(path), mode).open()
+    except (ValueError, ImportError) as e:
+        raise ValueError(
+            f"no filesystem registered for scheme {scheme!r} and fsspec "
+            f"cannot handle it ({e}); register one with "
+            f"lightgbm_tpu.utils.file_io.register_filesystem") from e
