@@ -122,3 +122,62 @@ def test_two_process_matches_single_process(tmp_path, uneven):
     multi = lgb.Booster(model_str=m0)
     np.testing.assert_allclose(multi.predict(X[:512]),
                                bst.predict(X[:512]), rtol=1e-5, atol=1e-6)
+
+
+def test_cli_shared_file_two_process(tmp_path):
+    """CLI multi-machine flow (reference CLI + mlist: the distributed
+    mockup of _test_distributed.py): both processes read the SAME csv,
+    pre_partition=false assigns contiguous row blocks per rank, and the
+    saved models match single-process training."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = np.random.RandomState(5)
+    n = 3000
+    X = r.randn(n, 6)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    np.savetxt(tmp_path / "train.csv",
+               np.column_stack([y, X]), delimiter=",", fmt="%.7f")
+    ports = [str(_free_port()), str(_free_port())]
+    machines = ",".join(f"127.0.0.1:{p}" for p in ports)
+    procs, outs = [], []
+    for rank in range(2):
+        out = tmp_path / f"cli_model_{rank}.txt"
+        outs.append(out)
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=4",
+                   LIGHTGBM_TPU_MACHINE_RANK=str(rank),
+                   PYTHONPATH=repo)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "lightgbm_tpu.cli",
+             "task=train", f"data={tmp_path / 'train.csv'}",
+             "label_column=0", "objective=binary", "num_iterations=5",
+             "num_leaves=15", "min_data_in_leaf=20", "verbosity=-1",
+             "boost_from_average=false", "tree_learner=data",
+             "num_machines=2", f"machines={machines}",
+             f"local_listen_port={ports[rank]}",
+             f"output_model={out}"],
+            env=env, cwd=str(tmp_path), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT))
+    for p in procs:
+        try:
+            out_text, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("CLI multi-process training timed out")
+        assert p.returncode == 0, out_text.decode()[-3000:]
+
+    import lightgbm_tpu as lgb
+    m0 = lgb.Booster(model_file=str(outs[0]))
+    m1 = lgb.Booster(model_file=str(outs[1]))
+    single = lgb.train(dict(objective="binary", num_leaves=15,
+                            verbosity=-1, min_data_in_leaf=20,
+                            boost_from_average=False,
+                            tree_learner="data"),
+                       lgb.Dataset(X, label=y), 5)
+    np.testing.assert_allclose(m0.predict(X[:400]), m1.predict(X[:400]),
+                               rtol=1e-7, atol=1e-8)
+    np.testing.assert_allclose(m0.predict(X[:400]),
+                               single.predict(X[:400]),
+                               rtol=1e-5, atol=1e-6)
