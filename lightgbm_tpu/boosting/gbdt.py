@@ -460,6 +460,28 @@ class GBDT:
                 self.missing_is_nan_d, self.is_cat_d, *extra)
         return tree, self._local_rows(row_node)[:self.num_data]
 
+    def _sync_renewed_leaves(self, tree: TreeArrays, row_node, rw
+                             ) -> TreeArrays:
+        """Multi-machine L1-family leaf renewal sync (reference
+        serial_tree_learner.cpp:747-757): each rank renews from its
+        local percentiles; the final leaf value is the mean of the
+        per-rank values over ranks that hold in-bag rows in the leaf."""
+        from jax.experimental import multihost_utils
+        m1 = tree.leaf_value.shape[0]
+        cnts = np.zeros(m1, np.float64)
+        np.add.at(cnts, np.asarray(row_node),
+                  (np.asarray(rw[:len(row_node)]) > 0).astype(np.float64))
+        lv = np.asarray(tree.leaf_value, np.float64)
+        has = (cnts > 0).astype(np.float64)
+        contrib = np.stack([np.where(has > 0, lv, 0.0), has])
+        total = np.asarray(multihost_utils.process_allgather(
+            np.ascontiguousarray(contrib))).sum(axis=0)
+        nz = np.maximum(total[1], 1.0)
+        synced = np.where(total[1] > 0, total[0] / nz, lv)
+        is_leaf = np.asarray(tree.is_leaf)
+        new_lv = np.where(is_leaf, synced, lv).astype(np.float32)
+        return tree._replace(leaf_value=jnp.asarray(new_lv))
+
     def _predict_train_rows(self, tree: TreeArrays) -> jax.Array:
         """Tree outputs for the (unpadded) training rows."""
         bins = self._local_bins if getattr(self, "_nproc", 1) > 1 \
@@ -617,6 +639,9 @@ class GBDT:
                         else self.train_score[:, cls],
                         jnp.asarray(self.objective.label), rw,
                         self.objective.renew_percentile, cfg.num_leaves)
+                    if getattr(self, "_nproc", 1) > 1:
+                        tree = self._sync_renewed_leaves(tree, row_node,
+                                                         rw)
                 if self._linear:
                     from ..learner.linear import fit_linear_leaves
                     with global_timer.timeit("linear_fit"):

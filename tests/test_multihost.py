@@ -30,7 +30,8 @@ _WORKER = textwrap.dedent("""
     X, y = make_data()
     cut = len(y) // 2 + int(os.environ.get("TEST_UNEVEN", "0"))
     sl = slice(0, cut) if rank == 0 else slice(cut, None)
-    params = dict(objective="binary", tree_learner="data",
+    objective = os.environ.get("TEST_OBJECTIVE", "binary")
+    params = dict(objective=objective, tree_learner="data",
                   num_machines=2,
                   machines=",".join(f"127.0.0.1:{{p}}" for p in ports),
                   local_listen_port=int(ports[rank]),
@@ -62,6 +63,18 @@ def _free_port():
 
 @pytest.mark.parametrize("uneven", [0, 17])
 def test_two_process_matches_single_process(tmp_path, uneven):
+    _run_two_process(tmp_path, uneven, "binary", exact=True)
+
+
+def test_two_process_l1_renew_sync(tmp_path):
+    # L1-family objectives renew leaves from percentiles; multi-machine
+    # averages per-rank renewed values (serial_tree_learner.cpp:747-757)
+    # — ranks must agree exactly, single-process parity is approximate
+    # (the reference has the same mean-of-local-percentiles semantics)
+    _run_two_process(tmp_path, 0, "regression_l1", exact=False)
+
+
+def _run_two_process(tmp_path, uneven, objective, exact):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     (tmp_path / "conftest_data.py").write_text(_DATA_MOD)
     (tmp_path / "worker.py").write_text(_WORKER.format(repo=repo))
@@ -78,6 +91,7 @@ def test_two_process_matches_single_process(tmp_path, uneven):
                    TEST_PORTS=",".join(ports),
                    TEST_OUT=str(out),
                    TEST_UNEVEN=str(uneven),
+                   TEST_OBJECTIVE=objective,
                    PYTHONPATH=str(tmp_path))
         # a site hook in some environments initializes the JAX backend at
         # interpreter start, which forbids jax.distributed.initialize;
@@ -115,13 +129,22 @@ def test_two_process_matches_single_process(tmp_path, uneven):
     finally:
         sys.path.pop(0)
     X, y = make_data()
-    bst = lgb.train(dict(objective="binary", tree_learner="data",
+    bst = lgb.train(dict(objective=objective, tree_learner="data",
                          num_leaves=15, verbosity=-1, min_data_in_leaf=20,
                          boost_from_average=False),
                     lgb.Dataset(X, label=y), 5)
     multi = lgb.Booster(model_str=m0)
-    np.testing.assert_allclose(multi.predict(X[:512]),
-                               bst.predict(X[:512]), rtol=1e-5, atol=1e-6)
+    if exact:
+        np.testing.assert_allclose(multi.predict(X[:512]),
+                                   bst.predict(X[:512]),
+                                   rtol=1e-5, atol=1e-6)
+    else:
+        a, b = multi.predict(X[:512]), bst.predict(X[:512])
+        # mean-of-local-percentiles vs global percentile: approximate by
+        # design (like the reference); rank equality above is the hard
+        # guarantee
+        assert np.corrcoef(a, b)[0, 1] > 0.9
+        assert np.mean(np.abs(a - b)) < 0.15
 
 
 def test_cli_shared_file_two_process(tmp_path):
