@@ -1,0 +1,24 @@
+# Test tiers (see pytest.ini): the default tier must stay green on every
+# commit; the slow tier (multihost subprocess tests, MXU interpret-mode
+# kernel matrix, reference-consistency differential tests) must pass
+# before a round is declared done. Both run on CPU via tests/conftest.py
+# (virtual 8-device mesh); bench.py is the only thing that touches the
+# real accelerator.
+
+PY ?= python
+
+.PHONY: test test-slow test-all bench install
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+test-slow:
+	$(PY) -m pytest tests/ -x -q -m slow
+
+test-all: test test-slow
+
+bench:
+	$(PY) bench.py
+
+install:
+	pip install -e . --no-build-isolation --no-deps

@@ -68,9 +68,27 @@ def _probe_backend(timeout_s: int = 180) -> str:
             proc.returncode, tail.splitlines()[-1] if tail else "no stderr")
 
 
+def _probe_with_retry() -> str:
+    """Probe; on failure keep retrying with a fixed interval inside a
+    bounded window (default: every 10 min for 1 h) so a transient tunnel
+    outage at bench time doesn't zero the round's official record.
+    Returns "" when healthy, else the last failure diagnosis."""
+    window_s = int(os.environ.get("BENCH_RETRY_WINDOW", 3600))
+    interval_s = int(os.environ.get("BENCH_RETRY_INTERVAL", 600))
+    deadline = time.time() + window_s
+    problem = _probe_backend()
+    while problem and time.time() + interval_s < deadline:
+        print(f"# accelerator probe failed ({problem}); retrying in "
+              f"{interval_s}s (window closes in "
+              f"{int(deadline - time.time())}s)", file=sys.stderr)
+        time.sleep(interval_s)
+        problem = _probe_backend()
+    return problem
+
+
 def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    problem = _probe_backend()
+    problem = _probe_with_retry()
     if problem:
         # emit a parseable, honest record instead of hanging the driver
         print(json.dumps({

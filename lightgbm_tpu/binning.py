@@ -223,8 +223,14 @@ class BinMapper:
         m.bin_upper_bound = np.asarray(bounds, dtype=np.float64)
         m.num_bin = len(bounds)
         # trivial when all data lands in one bin (constant feature) —
-        # reference prunes via is_trivial + feature_pre_filter
-        occupied = len(np.unique(m.values_to_bins_numeric_only(distinct)))
+        # reference prunes via is_trivial + feature_pre_filter. Bins are
+        # monotone over the sorted distinct values, so "one occupied bin"
+        # reduces to first and last landing together.
+        if len(distinct):
+            ends = m.values_to_bins_numeric_only(distinct[[0, -1]])
+            occupied = 1 if ends[0] == ends[1] else 2
+        else:
+            occupied = 0
         if na_cnt > 0:
             occupied += 1
         m.is_trivial = m.num_bin <= 1 or occupied <= 1
@@ -388,14 +394,59 @@ def find_bin_mappers(X: np.ndarray, max_bin: int = 255,
     else:
         sample = X
         total = num_data
+    # transpose once: per-feature slices become contiguous, which makes
+    # the per-column mask/filter/sort work ~5x faster than strided views
+    # (transpose + dtype conversion fused into a single allocation)
+    sample_t = np.ascontiguousarray(np.asarray(sample).T, dtype=np.float64)
     mappers = []
     for f in range(num_features):
-        col = np.asarray(sample[:, f], dtype=np.float64)
+        col = sample_t[f]
         nonzero = col[(np.abs(col) > _ZERO_THRESHOLD) | np.isnan(col)]
         mappers.append(BinMapper.from_sample(
             nonzero, total, max_bin, min_data_in_bin, use_missing,
             zero_as_missing, is_categorical=f in cat_set))
     return mappers
+
+
+def bin_columns(X: np.ndarray, feat_indices: Sequence[int],
+                mappers: Sequence["BinMapper"], dtype) -> np.ndarray:
+    """Quantize X[:, feat_indices[j]] with mappers[j] into a [N, len(used)]
+    bin matrix. Numeric features go through the native OpenMP whole-matrix
+    kernel when available (reference: DatasetLoader bins with full OMP,
+    dataset_loader.cpp); categorical features and the no-compiler fallback
+    use the vectorized NumPy path."""
+    from . import cext
+    num_data = X.shape[0]
+    out = np.empty((num_data, len(feat_indices)), dtype=dtype)
+    numeric = [j for j, m in enumerate(mappers) if not m.is_categorical]
+    if cext.available() and numeric and num_data > 10000:
+        bounds, offs, nsearch, nanb = [], [0], [], []
+        for j in numeric:
+            m = mappers[j]
+            n_numeric = m.num_bin - (1 if m.missing_type == MissingType.NAN
+                                     else 0)
+            sb = m.bin_upper_bound[:max(n_numeric - 1, 0)]
+            bounds.append(sb)
+            offs.append(offs[-1] + len(sb))
+            nsearch.append(len(sb))
+            nanb.append(m.num_bin - 1
+                        if m.missing_type == MissingType.NAN
+                        else m.default_bin)
+        flat = (np.concatenate(bounds) if bounds
+                else np.zeros(0, np.float64))
+        sub = cext.bin_matrix(
+            X, np.asarray([feat_indices[j] for j in numeric], np.int32),
+            flat, np.asarray(offs[:-1], np.int64),
+            np.asarray(nsearch, np.int32), np.asarray(nanb, np.int32),
+            dtype)
+        out[:, numeric] = sub
+        rest = [j for j, m in enumerate(mappers) if m.is_categorical]
+    else:
+        rest = range(len(mappers))
+    for j in rest:
+        out[:, j] = mappers[j].values_to_bins(
+            np.asarray(X[:, feat_indices[j]], dtype=np.float64)).astype(dtype)
+    return out
 
 
 def find_bin_mappers_sparse(X_csc, max_bin: int = 255,

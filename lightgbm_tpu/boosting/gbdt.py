@@ -139,15 +139,6 @@ class GBDT:
         self._mono_method = (cfg.monotone_constraints_method
                              if self._mono_nonbasic else "basic")
         self._setup_parallel(cfg)
-        if self._forced is not None and self._grower is not None:
-            Log.warning("forced splits are not supported with distributed "
-                        "tree learners yet; ignoring forcedsplits_filename")
-            self._forced = None
-        if self._cegb_cfg is not None and self._grower is not None:
-            Log.warning("CEGB penalties are not supported with distributed "
-                        "tree learners yet; ignoring cegb_* parameters")
-            self._cegb_cfg = None
-            self._cegb_state = None
         # TPU kernel choice (serial learner; the data-parallel sharded
         # path picks mxu in _setup_parallel, other modes keep the
         # portable scatter grower): "mxu" = sort/gather-free
@@ -357,6 +348,16 @@ class GBDT:
         # sync, application.cpp:170-175)
         self._sharded_rng = (cfg.feature_fraction_bynode < 1.0 or
                              cfg.extra_trees or cfg.use_quantized_grad)
+        if self._cegb_state is not None and \
+                self.comm.mode in ("data", "voting"):
+            # per-row lazy-charge flags shard with the rows; pad to the
+            # sharded row count like bins (padded rows never charge)
+            c, l, fu, rfu = self._cegb_state
+            if self._row_pad and rfu.shape[0] > 1:
+                rfu = jnp.pad(rfu, ((0, self._row_pad), (0, 0)))
+            if rfu.shape[0] > 1:
+                rfu = self._shard_rows(rfu)
+            self._cegb_state = (c, l, fu, rfu)
         self._grower = make_sharded_grower(
             self.mesh, self.comm, num_leaves=cfg.num_leaves,
             max_depth=cfg.max_depth, hp=self.hp,
@@ -366,6 +367,8 @@ class GBDT:
             interaction_groups=self._interaction_groups,
             feature_fraction_bynode=cfg.feature_fraction_bynode,
             with_rng=self._sharded_rng,
+            forced=self._forced, cegb_cfg=self._cegb_cfg,
+            with_cegb_state=self._cegb_cfg is not None,
             mxu_kwargs=dict(
                 hist_double_prec=cfg.gpu_use_dp,
                 tail_split_cap=cfg.tail_split_cap,
@@ -454,10 +457,18 @@ class GBDT:
         if getattr(self, "_sharded_rng", False):
             extra = (jax.random.fold_in(
                 jax.random.PRNGKey(cfg.extra_seed), self.iter_),)
+        if self._cegb_cfg is not None:
+            extra = extra + (self._cegb_state,)
         with self.mesh:
-            tree, row_node = self._grower(
+            out = self._grower(
                 self.bins, g, h, cnt, feature_mask, self.num_bins_d,
                 self.missing_is_nan_d, self.is_cat_d, *extra)
+        if self._cegb_cfg is not None:
+            tree, row_node, (fu, rfu) = out
+            self._cegb_state = (self._cegb_state[0], self._cegb_state[1],
+                                fu, rfu)
+        else:
+            tree, row_node = out
         return tree, self._local_rows(row_node)[:self.num_data]
 
     def _sync_renewed_leaves(self, tree: TreeArrays, row_node, rw

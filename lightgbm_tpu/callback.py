@@ -109,20 +109,45 @@ def reset_parameter(**kwargs: Any) -> Callable:
     return _callback
 
 
+class _BestTracker:
+    """Best-so-far state for one (dataset, metric) pair.
+
+    ``update`` applies the min_delta-thresholded improvement rule for the
+    metric's direction and snapshots the full evaluation list at the best
+    iteration (what EarlyStopException carries, per the reference
+    callback protocol)."""
+
+    __slots__ = ("sign", "delta", "best", "iteration", "snapshot")
+
+    def __init__(self, higher_better: bool, delta: float):
+        # compare in "higher is better" space: flip sign for loss metrics
+        self.sign = 1.0 if higher_better else -1.0
+        self.delta = float(delta)
+        self.best = float("-inf")
+        self.iteration = 0
+        self.snapshot: Any = None
+
+    def update(self, score: float, iteration: int, eval_list) -> None:
+        oriented = self.sign * score
+        if self.snapshot is None or oriented > self.best + self.delta:
+            self.best = oriented
+            self.iteration = iteration
+            self.snapshot = eval_list
+
+
 def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                    verbose: bool = True, min_delta: float = 0.0) -> Callable:
-    best_score: List[float] = []
-    best_iter: List[int] = []
-    best_score_list: List[Any] = []
-    cmp_op: List[Callable] = []
-    enabled = [True]
-    first_metric = [""]
+    """Stop training when no tracked validation metric improved for
+    ``stopping_rounds`` consecutive iterations (reference
+    callback.py _EarlyStoppingCallback protocol: raises
+    EarlyStopException carrying the best iteration + its eval list)."""
+    state: Dict[str, Any] = {"trackers": None, "enabled": True,
+                             "first_name": None}
 
-    def _init(env: CallbackEnv) -> None:
-        enabled[0] = not any(
-            env.params.get(alias, "") == "dart"
-            for alias in ("boosting", "boosting_type", "boost"))
-        if not enabled[0]:
+    def _start(env: CallbackEnv) -> None:
+        if any(env.params.get(k, "") == "dart"
+               for k in ("boosting", "boosting_type", "boost")):
+            state["enabled"] = False
             Log.warning("Early stopping is not available in dart mode")
             return
         if not env.evaluation_result_list:
@@ -132,63 +157,43 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
         if verbose:
             Log.info("Training until validation scores don't improve for "
                      "%d rounds", stopping_rounds)
-        n_metric = len(env.evaluation_result_list)
-        deltas = [min_delta] * n_metric if not isinstance(min_delta, list) \
-            else min_delta
-        first_metric[0] = env.evaluation_result_list[0][1]
-        for eval_ret, delta in zip(env.evaluation_result_list, deltas):
-            best_iter.append(0)
-            best_score_list.append(None)
-            if eval_ret[3]:  # higher is better
-                best_score.append(float("-inf"))
-                cmp_op.append(
-                    lambda curr, best, d=delta: curr > best + d)
-            else:
-                best_score.append(float("inf"))
-                cmp_op.append(
-                    lambda curr, best, d=delta: curr < best - d)
+        n = len(env.evaluation_result_list)
+        deltas = list(min_delta) if isinstance(min_delta, list) \
+            else [min_delta] * n
+        state["trackers"] = [
+            _BestTracker(higher_better=entry[3], delta=d)
+            for entry, d in zip(env.evaluation_result_list, deltas)]
+        # "first metric" = the metric name of the first eval entry
+        state["first_name"] = env.evaluation_result_list[0][1]
 
-    def _final_iteration_check(env, eval_name_splitted, i) -> None:
-        if env.iteration == env.end_iteration - 1:
-            if verbose:
-                Log.info("Did not meet early stopping. Best iteration is:"
-                         "\n[%d]\t%s", best_iter[i] + 1,
-                         "\t".join(_format_eval_result(x)
-                                   for x in best_score_list[i]))
-                if first_metric_only:
-                    Log.info("Evaluated only: %s", eval_name_splitted[-1])
-            raise EarlyStopException(best_iter[i], best_score_list[i])
+    def _stop(trk: _BestTracker, reason: str, metric_name: str) -> None:
+        if verbose:
+            summary = "\t".join(_format_eval_result(x)
+                                for x in trk.snapshot)
+            Log.info("%s Best iteration is:\n[%d]\t%s",
+                     reason, trk.iteration + 1, summary)
+            if first_metric_only:
+                Log.info("Evaluated only: %s", metric_name.split(" ")[-1])
+        raise EarlyStopException(trk.iteration, trk.snapshot)
 
     def _callback(env: CallbackEnv) -> None:
         if env.iteration == env.begin_iteration:
-            _init(env)
-        if not enabled[0]:
+            _start(env)
+        if not state["enabled"]:
             return
-        for i in range(len(env.evaluation_result_list)):
-            score = env.evaluation_result_list[i][2]
-            if best_score_list[i] is None or \
-                    cmp_op[i](score, best_score[i]):
-                best_score[i] = score
-                best_iter[i] = env.iteration
-                best_score_list[i] = env.evaluation_result_list
-            eval_name_splitted = env.evaluation_result_list[i][1].split(" ")
-            if first_metric_only and \
-                    first_metric[0] != env.evaluation_result_list[i][1]:
+        last_round = env.iteration == env.end_iteration - 1
+        for trk, entry in zip(state["trackers"],
+                              env.evaluation_result_list):
+            data_name, metric_name, score = entry[0], entry[1], entry[2]
+            trk.update(score, env.iteration, env.evaluation_result_list)
+            if first_metric_only and metric_name != state["first_name"]:
                 continue
-            if env.evaluation_result_list[i][0] == "cv_agg" or \
-                    env.evaluation_result_list[i][0] == "training":
-                _final_iteration_check(env, eval_name_splitted, i)
-                continue
-            if env.iteration - best_iter[i] >= stopping_rounds:
-                if verbose:
-                    Log.info("Early stopping, best iteration is:\n[%d]\t%s",
-                             best_iter[i] + 1,
-                             "\t".join(_format_eval_result(x)
-                                       for x in best_score_list[i]))
-                    if first_metric_only:
-                        Log.info("Evaluated only: %s",
-                                 eval_name_splitted[-1])
-                raise EarlyStopException(best_iter[i], best_score_list[i])
-            _final_iteration_check(env, eval_name_splitted, i)
+            # training-set and cv-aggregate scores never trigger a stop
+            # mid-run; they only terminate cleanly at the last round
+            counts = data_name not in ("cv_agg", "training")
+            if counts and env.iteration - trk.iteration >= stopping_rounds:
+                _stop(trk, "Early stopping.", metric_name)
+            if last_round:
+                _stop(trk, "Did not meet early stopping.", metric_name)
     _callback.order = 30
     return _callback

@@ -61,7 +61,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    lib = _load_or_build(_SRC, _LIB_PATH, flag_sets=((),))
+    lib = _load_or_build(_SRC, _LIB_PATH, flag_sets=(("-fopenmp",), ()))
     if lib is None:
         return None
     c_dp = ctypes.POINTER(ctypes.c_double)
@@ -82,6 +82,12 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.lgbt_values_to_bins.argtypes = [
         c_dp, ctypes.c_long, c_dp, ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_uint8)]
+    lib.lgbt_bin_matrix.restype = None
+    lib.lgbt_bin_matrix.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_long, ctypes.c_int,
+        c_ip, ctypes.c_int,
+        c_dp, ctypes.POINTER(ctypes.c_long), c_ip, c_ip,
+        ctypes.c_int, ctypes.c_void_p]
     _lib = lib
     return _lib
 
@@ -157,6 +163,41 @@ def values_to_bins_u8(values: np.ndarray, bounds: np.ndarray,
         bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         num_search, nan_bin,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out
+
+
+def bin_matrix(X: np.ndarray, feat_idx: np.ndarray, bounds_flat: np.ndarray,
+               bounds_off: np.ndarray, num_search: np.ndarray,
+               nan_bin: np.ndarray, dtype) -> np.ndarray:
+    """Quantize every listed numeric column of row-major X in one OpenMP
+    pass (DatasetLoader's parallel bin construction analog)."""
+    lib = get_lib()
+    assert lib is not None
+    # float32 is read natively: no whole-matrix float64 copy on the main
+    # dense-ingestion path (a 10M x 100 f32 input would transiently
+    # double its footprint otherwise)
+    if X.dtype == np.float32:
+        X = np.ascontiguousarray(X)
+        is_f32 = 1
+    else:
+        X = np.ascontiguousarray(X, np.float64)
+        is_f32 = 0
+    n, f_total = X.shape
+    feat_idx = np.ascontiguousarray(feat_idx, np.int32)
+    bounds_flat = np.ascontiguousarray(bounds_flat, np.float64)
+    bounds_off = np.ascontiguousarray(bounds_off, np.int64)
+    num_search = np.ascontiguousarray(num_search, np.int32)
+    nan_bin = np.ascontiguousarray(nan_bin, np.int32)
+    out = np.empty((n, len(feat_idx)), dtype)
+    lib.lgbt_bin_matrix(
+        X.ctypes.data_as(ctypes.c_void_p), is_f32, n, f_total,
+        feat_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        len(feat_idx),
+        bounds_flat.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        bounds_off.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        num_search.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        nan_bin.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        out.dtype.itemsize, out.ctypes.data_as(ctypes.c_void_p))
     return out
 
 

@@ -227,4 +227,92 @@ void lgbt_values_to_bins(const double* values, long n, const double* bounds,
   }
 }
 
+// ---------------------------------------------------------------------------
+// Whole-matrix quantization (the DatasetLoader OMP bin-construction analog,
+// dataset_loader.cpp): one pass over row-major X binning every used numeric
+// feature, parallel over rows so each thread streams X sequentially.
+//
+// Each feature gets a small uniform grid over its bound range; grid cell c
+// stores the insertion point of the cell's lower edge, so a value's binary
+// search is confined to [grid[c], grid[c+1]] — typically 0-2 bounds. Never
+// slower than a full binary search, ~4-6x fewer compares at max_bin=255.
+// bounds_flat/bounds_off: concatenated per-feature search bounds.
+// elem_size: 1 (uint8 out) or 2 (uint16 out); out is [n, n_used] row-major.
+// ---------------------------------------------------------------------------
+void lgbt_bin_matrix(const void* Xv, int x_is_f32, long n, int f_total,
+                     const int* feat_idx, int n_used,
+                     const double* bounds_flat, const long* bounds_off,
+                     const int* num_search, const int* nan_bin,
+                     int elem_size, void* out) {
+  const double* X64 = static_cast<const double*>(Xv);
+  const float* X32 = static_cast<const float*>(Xv);
+  uint8_t* out8 = static_cast<uint8_t*>(out);
+  uint16_t* out16 = static_cast<uint16_t*>(out);
+  const int G = 256;  // grid cells per feature (u16 table: L1-resident)
+  std::vector<uint16_t> grid(static_cast<size_t>(n_used) * (G + 1));
+  std::vector<double> glo(n_used), ginv(n_used);
+  for (int j = 0; j < n_used; ++j) {
+    const double* bnd = bounds_flat + bounds_off[j];
+    int ns = num_search[j];
+    uint16_t* gj = grid.data() + static_cast<size_t>(j) * (G + 1);
+    if (ns <= 0) {
+      glo[j] = 0.0; ginv[j] = 0.0;
+      for (int c = 0; c <= G; ++c) gj[c] = 0;
+      continue;
+    }
+    double lo_v = bnd[0], hi_v = bnd[ns - 1];
+    double span = hi_v - lo_v;
+    if (!(span > 0)) span = 1.0;
+    glo[j] = lo_v;
+    ginv[j] = G / span;
+    for (int c = 0; c <= G; ++c) {
+      double edge = lo_v + span * c / G;
+      int s = 0, e = ns;
+      while (s < e) {
+        int mid = (s + e) >> 1;
+        if (bnd[mid] < edge) s = mid + 1;
+        else e = mid;
+      }
+      gj[c] = static_cast<uint16_t>(s);
+    }
+    gj[G] = static_cast<uint16_t>(ns);
+  }
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(static)
+#endif
+  for (long i = 0; i < n; ++i) {
+    const long row0 = i * f_total;
+    for (int j = 0; j < n_used; ++j) {
+      double v = x_is_f32
+          ? static_cast<double>(X32[row0 + feat_idx[j]])
+          : X64[row0 + feat_idx[j]];
+      int b;
+      if (std::isnan(v)) {
+        b = nan_bin[j];
+      } else {
+        const double* bnd = bounds_flat + bounds_off[j];
+        const uint16_t* gj = grid.data() + static_cast<size_t>(j) * (G + 1);
+        double t = (v - glo[j]) * ginv[j];
+        // !(t > 0) also catches NaN t (0*inf from degenerate spans /
+        // infinite values) — casting NaN to int is UB and would index
+        // the grid out of bounds
+        int c = !(t > 0) ? 0 : (t >= G ? G - 1 : static_cast<int>(t));
+        int lo = gj[c], hi = gj[c + 1];
+        while (lo < hi) {
+          int mid = (lo + hi) >> 1;
+          if (bnd[mid] < v) lo = mid + 1;
+          else hi = mid;
+        }
+        b = lo;
+        // exactness fix-up: grid edges are recomputed in floating point,
+        // so the narrowed range can miss by one bound at a cell edge
+        while (b > 0 && bnd[b - 1] >= v) --b;
+        while (b < num_search[j] && bnd[b] < v) ++b;
+      }
+      if (elem_size == 1) out8[i * n_used + j] = static_cast<uint8_t>(b);
+      else out16[i * n_used + j] = static_cast<uint16_t>(b);
+    }
+  }
+}
+
 }  // extern "C"

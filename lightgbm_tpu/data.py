@@ -184,10 +184,8 @@ class BinnedDataset:
         used, used_mappers, dtype = _select_used_features(
             all_mappers, feature_pre_filter and
             (mappers is None or pre_filter_with_mappers))
-        binned = np.empty((num_data, len(used)), dtype=dtype)
-        for j, f in enumerate(used):
-            binned[:, j] = used_mappers[j].values_to_bins(
-                np.asarray(X[:, f], dtype=np.float64)).astype(dtype)
+        from .binning import bin_columns
+        binned = bin_columns(X, used, used_mappers, dtype)
         raw = np.ascontiguousarray(
             X[:, used], dtype=np.float32) if keep_raw else None
         return BinnedDataset(binned, used_mappers, used, num_total, metadata,
@@ -338,10 +336,9 @@ class BinnedDataset:
                 hi = min(lo + step, lens[ci])
                 block = chunk_rows(ci, lo, hi)
                 row0 = int(offsets[ci]) + lo
-                for j, f in enumerate(used):
-                    binned[row0:row0 + (hi - lo), j] = \
-                        used_mappers[j].values_to_bins(
-                            block[:, f]).astype(dtype)
+                from .binning import bin_columns
+                binned[row0:row0 + (hi - lo)] = bin_columns(
+                    np.asarray(block), used, used_mappers, dtype)
         return BinnedDataset(binned, used_mappers, used, num_total,
                              metadata, feature_names, raw=None)
 

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import collections
 import copy
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -263,16 +263,20 @@ def _make_n_folds(full_data: Dataset, folds, nfold: int, params,
 
 
 def _agg_cv_result(raw_results):
-    cvmap = collections.OrderedDict()
-    metric_type = {}
-    for one_result in raw_results:
-        for one_line in one_result:
-            key = f"{one_line[0]} {one_line[1]}"
-            metric_type[key] = one_line[3]
-            cvmap.setdefault(key, [])
-            cvmap[key].append(one_line[2])
-    return [("cv_agg", k, float(np.mean(v)), metric_type[k],
-             float(np.std(v))) for k, v in cvmap.items()]
+    """Collapse per-fold eval lists into cv_agg entries.
+
+    Each fold yields (data_name, metric_name, value, higher_better)
+    tuples; folds are aggregated per "data_name metric_name" key into
+    ("cv_agg", key, mean, higher_better, std), preserving first-seen
+    key order (the reference engine's cv display contract)."""
+    by_key: Dict[str, Tuple[bool, List[float]]] = {}
+    for fold in raw_results:
+        for data_name, metric_name, value, higher_better, *_ in fold:
+            slot = by_key.setdefault(f"{data_name} {metric_name}",
+                                     (higher_better, []))
+            slot[1].append(value)
+    return [("cv_agg", key, float(np.mean(vals)), hb, float(np.std(vals)))
+            for key, (hb, vals) in by_key.items()]
 
 
 def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,

@@ -186,6 +186,18 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 "monotone_constraints_method=%r is not supported with the "
                 "voting tree learner (partial histograms cannot be "
                 "cached)" % monotone_method)
+        # the all-nodes histogram cache is [M+1, F, bmax, 3] f32 — on wide
+        # feature sets this can dwarf HBM (F=1000, 255 leaves, 256 bins
+        # ~ 1.5 GB). Warn before allocating so an OOM is attributable.
+        cache_bytes = (m + 1) * f * bmax * 3 * 4
+        if cache_bytes > (1 << 30):
+            from ..utils.log import Log
+            Log.warning(
+                "monotone_constraints_method=%s allocates a %.1f GiB "
+                "histogram cache ([%d nodes, %d features, %d bins]); "
+                "reduce num_leaves/max_bin or use "
+                "monotone_constraints_method='basic' if this OOMs."
+                % (monotone_method, cache_bytes / 2**30, m + 1, f, bmax))
     k_top = num_leaves - 1             # static top-k size
     rows_sharded = comm is not None and comm.mode in ("data", "voting")
     if comm is not None and comm.mode == "feature":
@@ -357,12 +369,19 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 uncharged = jnp.zeros((s_scan + 1, f), jnp.float32) \
                     .at[rs].add((~st.row_feat_used).astype(jnp.float32) *
                                 cnt_weight[:, None])[:s_scan]
+                if rows_sharded:
+                    # the on-demand cost is a sum over ALL of a node's
+                    # rows; shards hold disjoint row sets, so merge like
+                    # the histogram reduce (every shard must apply the
+                    # identical penalty or trees diverge)
+                    uncharged = jax.lax.psum(uncharged, comm.axis)
                 gp += cegb_cfg.tradeoff * cegb_lazy[None, :] * uncharged
         else:
             gp = None
         if mono_rescan:
             cons_min_s, cons_max_s = recompute_bounds(
-                tree, monotone, num_bins, method=monotone_method)
+                tree, monotone, num_bins, method=monotone_method,
+                missing_is_nan=missing_is_nan)
         else:
             cons_min_s, cons_max_s = st.cons_min[sn], st.cons_max[sn]
         mono_kw = dict(monotone=monotone, cons_min=cons_min_s,

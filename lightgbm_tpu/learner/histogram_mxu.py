@@ -778,8 +778,11 @@ def _node_sums_kernel(nb: int, m: int):
 
         node = node_ref[:]                                   # [nb, 1] i32
         iota_m = jax.lax.broadcasted_iota(jnp.int32, (nb, m), 1)
-        oh = (node == iota_m).astype(jnp.bfloat16)           # [nb, M]
-        data = data_ref[:].astype(jnp.bfloat16)              # [nb, 8]
+        # full-f32 contraction: only 8 output columns, so unlike the
+        # histogram dots this one is cheap enough to keep exact — the
+        # "exact leaf refit" contract of node_sums_mxu depends on it
+        oh = (node == iota_m).astype(jnp.float32)            # [nb, M]
+        data = data_ref[:]                                   # [nb, 8] f32
         out_ref[0] += jax.lax.dot_general(
             oh, data, dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)              # [M, 8]
@@ -793,7 +796,7 @@ def node_sums_mxu(row_node: jax.Array, grad: jax.Array, hess: jax.Array,
                   cnt: jax.Array, *, num_nodes: int, row_block: int = 4096,
                   interpret: bool = False) -> jax.Array:
     """Exact per-node (grad, hess, count) sums from the row->node vector —
-    the double-bf16 one-hot contraction, gather-free. Used to recompute
+    a full-f32 one-hot contraction, gather-free. Used to recompute
     leaf values exactly after quantized growth (quantization then only
     ever perturbs the split SEARCH, never the fitted outputs; the
     reference's leaf output closed form gbdt.cpp:412 stays exact).

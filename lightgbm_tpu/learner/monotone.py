@@ -45,7 +45,7 @@ __all__ = ["recompute_bounds"]
 
 
 def recompute_bounds(tree, monotone: jax.Array, num_bins: jax.Array, *,
-                     method: str):
+                     method: str, missing_is_nan=None):
     """Per-node monotone output bounds from the current tree.
 
     Args:
@@ -53,6 +53,10 @@ def recompute_bounds(tree, monotone: jax.Array, num_bins: jax.Array, *,
       monotone: [F] int8/int32 constraint direction per feature.
       num_bins: [F] per-feature bin counts (advanced box bounds).
       method: "intermediate" | "advanced".
+      missing_is_nan: [F] bool — features whose LAST bin is the NaN bin.
+        The NaN bin sits outside the numeric order, so advanced box
+        extents exclude it: adjacency is evaluated in threshold space
+        only (a leaf collecting NaN rows is not "above" the numeric top).
 
     Returns:
       (cons_min, cons_max): [M+1] f32 bounds (±inf where unconstrained).
@@ -112,9 +116,13 @@ def recompute_bounds(tree, monotone: jax.Array, num_bins: jax.Array, *,
     thr = tree.threshold_bin.astype(jnp.int32)
     cons_min = jnp.full(m1, -inf)
     cons_max = jnp.full(m1, inf)
+    if missing_is_nan is None:
+        top_bin = num_bins.astype(jnp.int32) - 1
+    else:
+        top_bin = num_bins.astype(jnp.int32) - 1 - \
+            missing_is_nan.astype(jnp.int32)
     lo = jnp.zeros((m1, f), jnp.int32)
-    hi = jnp.broadcast_to((num_bins - 1)[None, :].astype(jnp.int32),
-                          (m1, f))
+    hi = jnp.broadcast_to(top_bin[None, :], (m1, f))
     # box per node: ancestors' thresholds refine the interval on their
     # split feature (right child: f > thr; left child: f <= thr)
     for g in range(f):
@@ -122,7 +130,7 @@ def recompute_bounds(tree, monotone: jax.Array, num_bins: jax.Array, *,
         lo_g = jnp.max(jnp.where(right_of & mask_j[None, :],
                                  (thr + 1)[None, :], 0), axis=1)
         hi_g = jnp.min(jnp.where(left_of & mask_j[None, :], thr[None, :],
-                                 num_bins[g] - 1), axis=1)
+                                 top_bin[g]), axis=1)
         lo = lo.at[:, g].set(lo_g)
         hi = hi.at[:, g].set(hi_g)
 

@@ -53,7 +53,8 @@ def make_sharded_grower(mesh: Mesh, comm: CommSpec, *, num_leaves: int,
                         monotone_method: str = "basic",
                         interaction_groups: Optional[tuple] = None,
                         feature_fraction_bynode: float = 1.0,
-                        with_rng: bool = False):
+                        with_rng: bool = False, forced=None,
+                        cegb_cfg=None, with_cegb_state: bool = False):
     """Build a shard_map'ped grower with the given static config.
 
     use_mxu (data-parallel only) runs the MXU grower inside shard_map
@@ -87,20 +88,42 @@ def make_sharded_grower(mesh: Mesh, comm: CommSpec, *, num_leaves: int,
             comm=comm, monotone=monotone,
             monotone_method=monotone_method,
             interaction_groups=interaction_groups,
-            feature_fraction_bynode=feature_fraction_bynode)
+            feature_fraction_bynode=feature_fraction_bynode,
+            forced=forced, cegb_cfg=cegb_cfg)
 
+    # forced-split spec arrays are baked in as static closures (tree-wide
+    # constants); CEGB state travels as a live argument because the
+    # row_feat_used flags persist and grow across trees. Its per-row
+    # component shards with the rows (reference is_feature_used_ is
+    # per-datapoint, cost_effective_gradient_boosting.hpp:56).
     in_specs = (data_spec, data_spec, data_spec, data_spec,
-                P(), P(), P(), P()) + ((P(),) if with_rng else ())
+                P(), P(), P(), P())
+    if with_rng:
+        in_specs += (P(),)
+    if with_cegb_state:
+        # the per-row flags only exist under the lazy penalty; the (1,1)
+        # placeholder otherwise must stay replicated
+        rfu_spec = data_spec if (cegb_cfg is not None and
+                                 cegb_cfg.has_lazy) else P()
+        in_specs += ((P(), P(), P(), rfu_spec),)
+    out_specs = (P(), data_spec)
+    if with_cegb_state:
+        out_specs = (P(), data_spec, (P(), rfu_spec))
 
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=in_specs,
-        out_specs=(P(), data_spec),
+        out_specs=out_specs,
         check_vma=False)
     def sharded(bins, grad, hess, cnt, feature_mask, num_bins,
-                missing_is_nan, is_cat, *maybe_key):
+                missing_is_nan, is_cat, *rest):
+        rest = list(rest)
+        kw = {}
+        if with_rng:
+            kw["rng_key"] = rest.pop(0)
+        if with_cegb_state:
+            kw["cegb_state"] = tuple(rest.pop(0))
         return grower(bins, grad, hess, cnt, feature_mask, num_bins,
-                      missing_is_nan, is_cat,
-                      **({"rng_key": maybe_key[0]} if maybe_key else {}))
+                      missing_is_nan, is_cat, **kw)
 
     return jax.jit(sharded)

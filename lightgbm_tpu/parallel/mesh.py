@@ -115,7 +115,27 @@ def setup_multihost(num_machines: int, machines: str = "",
     try:
         from jax._src.distributed import global_state as _dstate
         if _dstate.client is not None:
-            return  # rendezvous already done (e.g. by the launcher)
+            # rendezvous already done (e.g. by the launcher). A stale
+            # rendezvous that doesn't match THIS machine list would make
+            # collectives hang or span wrong ranks — verify, don't trust.
+            want_rank = os.environ.get("LIGHTGBM_TPU_MACHINE_RANK")
+            got_n = getattr(_dstate, "num_processes", None)
+            got_rank = getattr(_dstate, "process_id", None)
+            if got_n is not None and got_n != num_machines:
+                raise RuntimeError(
+                    f"a jax.distributed rendezvous already exists with "
+                    f"{got_n} processes, but num_machines={num_machines} "
+                    f"was requested. Re-fitting with a different machine "
+                    f"set requires fresh worker processes (the JAX "
+                    f"rendezvous is once-per-process, like the "
+                    f"reference's Network::Init socket ring).")
+            if (want_rank is not None and got_rank is not None
+                    and int(want_rank) != got_rank):
+                raise RuntimeError(
+                    f"existing rendezvous has rank {got_rank} but "
+                    f"LIGHTGBM_TPU_MACHINE_RANK={want_rank}; restart the "
+                    f"worker processes to change machine ranks.")
+            return
     except ImportError:
         pass
     try:

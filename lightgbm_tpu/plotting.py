@@ -1,6 +1,11 @@
 """Plotting utilities (reference python-package/lightgbm/plotting.py, 690 LoC):
 plot_importance, plot_metric, plot_split_value_histogram, plot_tree /
 create_tree_digraph. Matplotlib/graphviz are imported lazily and optional.
+
+The public signatures and plot semantics match the reference package (the
+API contract); the internals are organised differently — axis setup and
+decoration are centralised in ``_axes``/``_finish`` instead of repeated
+per function.
 """
 
 from __future__ import annotations
@@ -17,9 +22,39 @@ __all__ = ["plot_importance", "plot_metric", "plot_split_value_histogram",
            "plot_tree", "create_tree_digraph"]
 
 
-def _check_not_tuple_of_2_elements(obj, obj_name: str) -> None:
-    if not isinstance(obj, tuple) or len(obj) != 2:
-        raise TypeError(f"{obj_name} must be a tuple of 2 elements.")
+def _pair(value, name: str):
+    """Validate a 2-tuple argument (figsize/xlim/ylim) and return it."""
+    if not isinstance(value, tuple) or len(value) != 2:
+        raise TypeError(f"{name} must be a tuple of 2 elements.")
+    return value
+
+
+def _axes(ax, figsize, dpi):
+    """Return the target axes, creating a figure when none was passed."""
+    if ax is not None:
+        return ax
+    import matplotlib.pyplot as plt
+    if figsize is not None:
+        _pair(figsize, "figsize")
+    fig, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    return ax
+
+
+def _finish(ax, *, title=None, xlabel=None, ylabel=None, xlim=None,
+            ylim=None, grid=True):
+    """Apply the shared decoration set every plot_* function supports."""
+    if xlim is not None:
+        ax.set_xlim(_pair(xlim, "xlim"))
+    if ylim is not None:
+        ax.set_ylim(_pair(ylim, "ylim"))
+    if title:
+        ax.set_title(title)
+    if xlabel:
+        ax.set_xlabel(xlabel)
+    if ylabel:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
 
 
 def _to_booster(booster) -> Booster:
@@ -39,48 +74,34 @@ def plot_importance(booster, ax=None, height: float = 0.2,
                     max_num_features: Optional[int] = None,
                     ignore_zero: bool = True, figsize=None, dpi=None,
                     grid: bool = True, precision: int = 3, **kwargs):
-    import matplotlib.pyplot as plt
     bst = _to_booster(booster)
     if importance_type == "auto":
         importance_type = "split"
-    importance = bst.feature_importance(importance_type)
-    feature_name = bst.feature_name()
-    if not len(importance):
+    imp = np.asarray(bst.feature_importance(importance_type), dtype=float)
+    if imp.size == 0:
         raise ValueError("Booster's feature_importance is empty.")
-    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
-    if ignore_zero:
-        tuples = [x for x in tuples if x[1] > 0]
+    names = np.asarray(bst.feature_name(), dtype=object)
+
+    keep = imp > 0 if ignore_zero else np.ones(imp.shape, bool)
+    order = np.argsort(imp[keep], kind="stable")  # ascending -> top bar last
+    sel = np.flatnonzero(keep)[order]
     if max_num_features is not None and max_num_features > 0:
-        tuples = tuples[-max_num_features:]
-    if not tuples:
+        sel = sel[-max_num_features:]
+    if sel.size == 0:
         raise ValueError("There are no importances to plot.")
-    labels, values = zip(*tuples)
-    if ax is None:
-        if figsize is not None:
-            _check_not_tuple_of_2_elements(figsize, "figsize")
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
-    ylocs = np.arange(len(values))
-    ax.barh(ylocs, values, align="center", height=height, **kwargs)
-    for x, y in zip(values, ylocs):
-        ax.text(x + 1, y,
-                f"{x:.{precision}f}" if importance_type == "gain" else str(x),
+
+    ax = _axes(ax, figsize, dpi)
+    ys = np.arange(sel.size)
+    ax.barh(ys, imp[sel], align="center", height=height, **kwargs)
+    is_gain = importance_type == "gain"
+    for yi, fi in enumerate(sel):
+        v = imp[fi]
+        ax.text(v + 1, yi, f"{v:.{precision}f}" if is_gain else str(int(v)),
                 va="center")
-    ax.set_yticks(ylocs)
-    ax.set_yticklabels(labels)
-    if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
-        ax.set_xlim(xlim)
-    if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
-        ax.set_ylim(ylim)
-    if title:
-        ax.set_title(title)
-    if xlabel:
-        ax.set_xlabel(xlabel)
-    if ylabel:
-        ax.set_ylabel(ylabel)
-    ax.grid(grid)
-    return ax
+    ax.set_yticks(ys)
+    ax.set_yticklabels(names[sel])
+    return _finish(ax, title=title, xlabel=xlabel, ylabel=ylabel,
+                   xlim=xlim, ylim=ylim, grid=grid)
 
 
 def plot_metric(booster: Union[Dict, Booster], metric: Optional[str] = None,
@@ -88,33 +109,29 @@ def plot_metric(booster: Union[Dict, Booster], metric: Optional[str] = None,
                 xlim=None, ylim=None, title: str = "Metric during training",
                 xlabel: str = "Iterations", ylabel: str = "@metric@",
                 figsize=None, dpi=None, grid: bool = True):
-    import matplotlib.pyplot as plt
     if isinstance(booster, dict):
-        eval_results = booster
+        history = booster
     elif isinstance(booster, LGBMModel):
-        eval_results = dict(booster.evals_result_)
+        history = dict(booster.evals_result_)
     else:
         raise TypeError("booster must be dict or LGBMModel.")
-    if not eval_results:
+    if not history:
         raise ValueError("eval results cannot be empty.")
-    if ax is None:
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
-    names = dataset_names or list(eval_results.keys())
-    msets = eval_results[names[0]]
+
+    names = list(dataset_names) if dataset_names else list(history)
     if metric is None:
-        metric = list(msets.keys())[0]
+        # default: first metric recorded for the first dataset
+        metric = next(iter(history[names[0]]))
+
+    ax = _axes(ax, figsize, dpi)
     for name in names:
-        if metric not in eval_results.get(name, {}):
-            continue
-        results = eval_results[name][metric]
-        ax.plot(range(len(results)), results, label=name)
+        curve = history.get(name, {}).get(metric)
+        if curve is not None:
+            ax.plot(np.arange(len(curve)), curve, label=name)
     ax.legend(loc="best")
-    if title:
-        ax.set_title(title)
-    ax.set_xlabel(xlabel)
-    ax.set_ylabel(ylabel.replace("@metric@", metric))
-    ax.grid(grid)
-    return ax
+    return _finish(ax, title=title, xlabel=xlabel,
+                   ylabel=ylabel.replace("@metric@", metric),
+                   xlim=xlim, ylim=ylim, grid=grid)
 
 
 def plot_split_value_histogram(booster, feature, bins=None, ax=None,
@@ -123,7 +140,6 @@ def plot_split_value_histogram(booster, feature, bins=None, ax=None,
                                      "@index/name@ @feature@",
                                xlabel="Feature split value", ylabel="Count",
                                figsize=None, dpi=None, grid: bool = True):
-    import matplotlib.pyplot as plt
     bst = _to_booster(booster)
     model = bst._host_model()
     if isinstance(feature, str):
@@ -141,18 +157,14 @@ def plot_split_value_histogram(booster, feature, bins=None, ax=None,
             "Cannot plot split value histogram, "
             f"because feature {feature} was not used in splitting")
     hist, bin_edges = np.histogram(values, bins=bins or "auto")
-    if ax is None:
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ax = _axes(ax, figsize, dpi)
     centers = (bin_edges[:-1] + bin_edges[1:]) / 2
     ax.bar(centers, hist, width=width_coef * (bin_edges[1] - bin_edges[0]))
     if title:
         title = title.replace("@feature@", str(feature)).replace(
             "@index/name@", "name" if isinstance(feature, str) else "index")
-        ax.set_title(title)
-    ax.set_xlabel(xlabel)
-    ax.set_ylabel(ylabel)
-    ax.grid(grid)
-    return ax
+    return _finish(ax, title=title, xlabel=xlabel, ylabel=ylabel,
+                   xlim=xlim, ylim=ylim, grid=grid)
 
 
 def create_tree_digraph(booster, tree_index: int = 0,
@@ -208,9 +220,7 @@ def plot_tree(booster, ax=None, tree_index: int = 0, figsize=None, dpi=None,
               orientation: str = "horizontal", **kwargs):
     import io
     import matplotlib.image as mpimg
-    import matplotlib.pyplot as plt
-    if ax is None:
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ax = _axes(ax, figsize, dpi)
     graph = create_tree_digraph(booster, tree_index=tree_index,
                                 show_info=show_info, precision=precision,
                                 orientation=orientation, **kwargs)

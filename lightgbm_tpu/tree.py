@@ -665,12 +665,6 @@ class HostModel:
         if tree_strs:
             body += "\n"
         body += "end of trees\n"
-        # pandas category lists (reference gbdt_model_text via python
-        # basic.py:591-624: the file remembers training-time category
-        # orderings so DataFrame prediction encodes identically)
-        import json as _json
-        body += "\npandas_categorical:%s\n" % _json.dumps(
-            self.pandas_categorical, default=str)
         imp = self.feature_importance("split")
         pairs = sorted(
             [(int(imp[i]), self.feature_names[i])
@@ -684,6 +678,12 @@ class HostModel:
             for kk, v in self.params.items():
                 body += f"[{kk}: {v}]\n"
             body += "end of parameters\n"
+        # pandas category lists (reference python basic.py:591-624): the
+        # reference's _load_pandas_categorical reads only the file tail, so
+        # this must be the LAST line of the model string.
+        import json as _json
+        body += "\npandas_categorical:%s\n" % _json.dumps(
+            self.pandas_categorical, default=str)
         return body
 
     @staticmethod
