@@ -123,6 +123,38 @@ def _load_svmlight_or_csv(path: str) -> np.ndarray:
         return np.loadtxt(fh, delimiter=delim)
 
 
+def _sample_chunked_rows(chunks, take: int, seed: int) -> np.ndarray:
+    """Materialize a row sample from a list of chunks/Sequences without
+    loading more than one batch window at a time (the streamed analog of
+    the reference's pre-allgather sampling, dataset_loader.cpp:722)."""
+    lens = [len(c) if not hasattr(c, "shape") else c.shape[0]
+            for c in chunks]
+    offsets = np.concatenate([[0], np.cumsum(lens)]).astype(np.int64)
+    num_data = int(offsets[-1])
+    rng = np.random.RandomState(seed)
+    if num_data <= take:
+        idx = np.arange(num_data)
+    elif num_data > 4 * take:
+        idx = np.unique(rng.randint(0, num_data, size=take))
+    else:
+        idx = np.sort(rng.choice(num_data, size=take, replace=False))
+    parts = []
+    for ci in range(len(chunks)):
+        sel = idx[(idx >= offsets[ci]) & (idx < offsets[ci + 1])]
+        if len(sel) == 0:
+            continue
+        local = sel - offsets[ci]
+        step = getattr(chunks[ci], "batch_size", 65536) or 65536
+        for lo in range(0, lens[ci], step):
+            hi = min(lo + step, lens[ci])
+            sel_b = local[(local >= lo) & (local < hi)]
+            if len(sel_b) == 0:
+                continue
+            block = np.asarray(chunks[ci][lo:hi], dtype=np.float64)
+            parts.append(block.reshape(hi - lo, -1)[sel_b - lo])
+    return np.concatenate(parts, axis=0)
+
+
 def _distributed_bin_mappers(X, cfg, cat, sparse_in):
     """Multi-machine bin finding: every rank contributes an equal-size
     sample of its local rows via allgather, and all ranks derive
@@ -135,15 +167,17 @@ def _distributed_bin_mappers(X, cfg, cat, sparse_in):
             return None
     except RuntimeError:
         return None
-    if not (hasattr(X, "shape") or _is_sparse(X)):
-        raise NotImplementedError(
-            "multi-machine training with chunked/Sequence input is not "
-            "supported yet (bin mappers would not be synchronized "
-            "across machines); pass an array or sparse matrix")
     from jax.experimental import multihost_utils
     from .binning import find_bin_mappers
     nproc = jax.process_count()
     per = max(1, cfg.bin_construct_sample_cnt // nproc)
+    chunked = not (hasattr(X, "shape") or _is_sparse(X))
+    if chunked:
+        # streamed input: sample rows out of the local chunk iterator and
+        # allgather exactly like the array path — the reference's
+        # distributed loader samples from any local iterator the same way
+        # (dataset_loader.cpp:722-807 sample-then-allgather)
+        X = _sample_chunked_rows(X, per, cfg.data_random_seed)
     n_local = X.shape[0]
     # variable-size sample gather with fixed wire shapes: every rank
     # ships `per` rows (zero-padded) plus its true count, and the

@@ -72,11 +72,38 @@ class GBDT:
     def _setup_train(self, ds: BinnedDataset) -> None:
         cfg = self.config
         self.num_data = ds.num_data
-        self.bins = jnp.asarray(ds.bins)
         self.num_bins_d = jnp.asarray(ds.num_bins)
         self.missing_is_nan_d = jnp.asarray(ds.missing_types == 2)
         self.is_cat_d = jnp.asarray(ds.is_categorical)
         self.bmax = int(ds.num_bins.max()) if ds.num_features else 2
+        # EFB (reference feature_group.h:25; efb.py): bundle mutually-
+        # exclusive sparse features so histogram work scales with the
+        # bundle count, not the raw feature count. Only the device bin
+        # matrix changes shape; growers translate through static tables.
+        self._efb = None
+        try:
+            nproc_now = jax.process_count()
+        except RuntimeError:
+            nproc_now = 1
+        if nproc_now > 1 and cfg.enable_bundle:
+            # the greedy plan is derived from LOCAL rows; ranks would
+            # disagree on bundle membership/width and the SPMD programs
+            # would diverge. A synced plan (sample-then-allgather like
+            # the bin mappers) is future work.
+            Log.info("EFB disabled under multi-machine training "
+                     "(bundle plans are not yet synchronized)")
+        elif cfg.enable_bundle and not cfg.linear_tree and ds.num_features:
+            from ..efb import build_plan, bundle_matrix, make_device_tables
+            plan = build_plan(np.asarray(ds.bins), ds.num_bins,
+                              ds.default_bins,
+                              np.asarray(ds.is_categorical),
+                              max_bundle_bins=256)
+            if plan is not None and plan.effective:
+                self._efb = make_device_tables(plan, ds.default_bins)
+                self.bins = jnp.asarray(bundle_matrix(
+                    np.asarray(ds.bins), plan))
+        if self._efb is None:
+            self.bins = jnp.asarray(ds.bins)
         k = self.num_tree_per_iteration
         shape = (self.num_data,) if k == 1 else (self.num_data, k)
         self.train_score = jnp.zeros(shape, jnp.float32)
@@ -149,10 +176,12 @@ class GBDT:
             # the mxu kernels carry bin values through bf16 matmul
             # operands, exact only for max_bin <= 256
             if self._forced is None and self._cegb_cfg is None and \
-                    self.bmax <= 256 and not self._mono_nonbasic:
+                    self.bmax <= 256 and not self._mono_nonbasic and \
+                    self._efb is None:
                 self._hist_impl = "mxu"
             else:
-                self._hist_impl = "pallas"
+                self._hist_impl = "pallas" if self._efb is None \
+                    else "scatter"
         else:
             self._hist_impl = "scatter"
         Log.debug("Tree kernel path: %s (backend=%s)", self._hist_impl,
@@ -340,7 +369,7 @@ class GBDT:
         use_mxu = (cfg.use_pallas and jax.default_backend() != "cpu" and
                    self.comm.mode == "data" and self.bmax <= 256 and
                    self._forced is None and self._cegb_cfg is None and
-                   not self._mono_nonbasic)
+                   not self._mono_nonbasic and self._efb is None)
         self._sharded_mxu = use_mxu
         # per-node sampling / extra_trees / quantized rounding need a
         # per-iteration key; it rides into shard_map replicated so every
@@ -369,6 +398,7 @@ class GBDT:
             with_rng=self._sharded_rng,
             forced=self._forced, cegb_cfg=self._cegb_cfg,
             with_cegb_state=self._cegb_cfg is not None,
+            efb=self._efb,
             mxu_kwargs=dict(
                 hist_double_prec=cfg.gpu_use_dp,
                 tail_split_cap=cfg.tail_split_cap,
@@ -437,7 +467,7 @@ class GBDT:
                 rng_key=rng_key, hist_impl=self._hist_impl,
                 forced=self._forced, cegb_cfg=self._cegb_cfg,
                 cegb_state=self._cegb_state,
-                monotone_method=self._mono_method)
+                monotone_method=self._mono_method, efb=self._efb)
             if self._cegb_cfg is not None:
                 tree, row_node, (fu, rfu) = out
                 # feature-used flags persist across the whole model
@@ -498,7 +528,7 @@ class GBDT:
         bins = self._local_bins if getattr(self, "_nproc", 1) > 1 \
             else self.bins
         vals = predict_binned_tree(tree, bins, self.num_bins_d,
-                                   self.missing_is_nan_d)
+                                   self.missing_is_nan_d, self._efb)
         return vals[:self.num_data] if self._row_pad else vals
 
     def add_valid(self, ds: BinnedDataset, name: str,
@@ -701,7 +731,7 @@ class GBDT:
 
     def _feature_mask(self) -> jax.Array:
         cfg = self.config
-        f = self.bins.shape[1]
+        f = int(self.num_bins_d.shape[0])  # original features (not Fb)
         if cfg.feature_fraction >= 1.0:
             return jnp.ones(f, jnp.float32)
         key = jax.random.fold_in(
@@ -767,15 +797,17 @@ class GBDT:
             if idx < len(self.linear_models) else None
 
     def _tree_values(self, tree: TreeArrays, lin, bins: jax.Array,
-                     raw) -> jax.Array:
-        """Per-row outputs of one tree on a binned matrix (linear-aware)."""
+                     raw, efb=None) -> jax.Array:
+        """Per-row outputs of one tree on a binned matrix (linear-aware).
+        `efb` must be passed iff `bins` is the bundled training matrix
+        (validation matrices stay unbundled)."""
         if lin is None:
             return predict_binned_tree(tree, bins, self.num_bins_d,
-                                       self.missing_is_nan_d)
+                                       self.missing_is_nan_d, efb)
         from ..learner.linear import linear_leaf_values
         from ..learner.predict import leaf_node_tree
         leaf = leaf_node_tree(tree, bins, self.num_bins_d,
-                              self.missing_is_nan_d)
+                              self.missing_is_nan_d, efb)
         return linear_leaf_values(tree, lin, leaf, raw)
 
     def _update_score(self, tree: TreeArrays, row_node: jax.Array,
@@ -821,8 +853,8 @@ class GBDT:
             if lin is None:
                 vals = self._predict_train_rows(tree)
             else:
-                vals = self._tree_values(tree, lin, self.bins, self.raw) \
-                    [:self.num_data]
+                vals = self._tree_values(tree, lin, self.bins, self.raw,
+                                         self._efb)[:self.num_data]
             if k == 1:
                 self.train_score = self.train_score - vals
             else:

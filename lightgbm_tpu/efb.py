@@ -1,0 +1,278 @@
+"""Exclusive Feature Bundling, TPU-native (reference feature_group.h:25,
+docs/Features.rst:36 "Optimal Split for Exclusive Feature Bundling",
+dataset.cpp FindGroups/FastFeatureBundling).
+
+Wide sparse data makes the histogram kernels pay per-feature lane padding:
+a 3-bin one-hot still occupies a full 128-lane dot on the MXU, so 1000
+mostly-exclusive features cost ~1000 padded columns. Bundling packs
+mutually-exclusive features into shared uint8 columns (one feature's
+non-default bins after another), so the histogram stage runs on
+``[S, Fb, Bb]`` with Fb ≪ F — the flop/bandwidth win — and the rest of
+the learner is unchanged by construction:
+
+- the bundled histogram is EXPANDED on device back to per-original-feature
+  histograms (``expand_histograms``): positions map by a static gather;
+  each feature's default-bin mass is reconstructed as
+  ``node_total - segment_sum`` (rows not active in a feature sit outside
+  its segment). With conflict rate 0 the expansion equals the unbundled
+  histogram exactly, so the existing split scan (gain forms, missing
+  handling, monotone, CEGB, sampling masks) runs verbatim on original
+  features;
+- routing translates a chosen (original feature, threshold) into bundle
+  space with static tables (segment range + local-bin lookup), keeping
+  bin semantics identical (``route_bins``);
+- the model/host boundary never sees bundles: trees store original
+  features and thresholds.
+
+The reference instead scans the bundled histogram per sub-feature range
+(feature_histogram.hpp offsets); the expansion design was chosen so one
+scan implementation serves bundled and unbundled data bit-identically.
+
+Single-feature bundles keep their identity mapping (column == original
+feature column, default bin at its original position), so dense features
+pay nothing.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+
+from .utils.log import Log
+
+__all__ = ["EfbPlan", "build_plan", "bundle_matrix", "make_device_tables",
+           "expand_histograms", "route_bins"]
+
+
+class EfbPlan(NamedTuple):
+    """Host-side bundling plan over USED-feature indices."""
+    bundles: List[List[int]]        # per column: used-feature indices
+    col_of_feat: np.ndarray         # [F] bundle column of each feature
+    seg_lo: np.ndarray              # [F] first bundle-bin of f's segment
+    seg_hi: np.ndarray              # [F] last bundle-bin of f's segment
+    is_multi: np.ndarray            # [F] True when f shares its column
+    pos_of_local: np.ndarray        # [F, bmax] bundle-bin of local bin b
+    #                                 (-1: reconstructed default, -2: pad)
+    local_of_pos: np.ndarray        # [Fb, Bb] local bin at column position
+    col_bins: np.ndarray            # [Fb] bins used per column
+    num_cols: int
+    bundle_bmax: int                # Bb (max bins over columns)
+
+    @property
+    def effective(self) -> bool:
+        return bool(np.any(self.is_multi))
+
+
+def build_plan(bins: np.ndarray, num_bins: np.ndarray,
+               default_bins: np.ndarray, is_categorical: np.ndarray,
+               *, max_bundle_bins: int = 256, sample_rows: int = 20000,
+               max_conflict_frac: float = 0.0,
+               min_sparsity: float = 0.8) -> Optional[EfbPlan]:
+    """Greedy conflict-bounded bundling (reference dataset.cpp FindGroups:
+    features in decreasing non-default count order join the first bundle
+    whose occupied-row overlap stays within budget and whose bin total
+    fits). Returns None when nothing bundles (narrow or dense data).
+
+    Only sufficiently sparse numeric features are bundled; dense and
+    categorical features keep identity columns.
+    """
+    n, f = bins.shape
+    if f < 8:
+        return None
+    rs = np.random.RandomState(13)
+    rows = np.arange(n) if n <= sample_rows else \
+        np.sort(rs.choice(n, sample_rows, replace=False))
+    sub = np.ascontiguousarray(bins[rows].T)            # [F, S] contiguous
+    nondef = sub != default_bins[:, None]               # [F, S]
+    nd_cnt = nondef.sum(axis=1)
+    s = len(rows)
+
+    can_bundle = (~is_categorical) & (nd_cnt <= (1.0 - min_sparsity) * s) \
+        & (num_bins >= 2)
+    budget = int(max_conflict_frac * s)
+
+    order = np.argsort(nd_cnt, kind="stable")[::-1]     # dense-first
+    occ: List[np.ndarray] = []                          # per multi-bundle
+    bins_used: List[int] = []
+    members: List[List[int]] = []
+    singleton: List[int] = []
+    for fi in order:
+        fi = int(fi)
+        if not can_bundle[fi]:
+            singleton.append(fi)
+            continue
+        need = int(num_bins[fi]) - 1                    # non-default bins
+        placed = False
+        for b in range(len(occ)):
+            if bins_used[b] + need > max_bundle_bins:
+                continue
+            if int(np.count_nonzero(occ[b] & nondef[fi])) <= budget:
+                members[b].append(fi)
+                occ[b] |= nondef[fi]
+                bins_used[b] += need
+                placed = True
+                break
+        if not placed:
+            members.append([fi])
+            occ.append(nondef[fi].copy())
+            bins_used.append(1 + need)
+    # bundles that stayed alone revert to identity columns
+    for b in range(len(members) - 1, -1, -1):
+        if len(members[b]) == 1:
+            singleton.append(members[b][0])
+            del members[b], occ[b], bins_used[b]
+    if not members:
+        return None
+
+    bundles = [sorted(m) for m in members] + [[fi] for fi in
+                                              sorted(singleton)]
+    bmax = int(num_bins.max())
+    col_of_feat = np.zeros(f, np.int32)
+    seg_lo = np.zeros(f, np.int32)
+    seg_hi = np.zeros(f, np.int32)
+    is_multi = np.zeros(f, bool)
+    pos_of_local = np.full((f, bmax), -2, np.int32)
+    col_bins = np.zeros(len(bundles), np.int32)
+    for g, feats in enumerate(bundles):
+        multi = len(feats) > 1
+        pos = 1 if multi else 0                         # pos 0 = default
+        for fi in feats:
+            col_of_feat[fi] = g
+            is_multi[fi] = multi
+            nb = int(num_bins[fi])
+            if multi:
+                seg_lo[fi] = pos
+                for b in range(nb):
+                    if b == int(default_bins[fi]):
+                        pos_of_local[fi, b] = -1        # reconstructed
+                    else:
+                        pos_of_local[fi, b] = pos
+                        pos += 1
+                seg_hi[fi] = pos - 1
+            else:
+                seg_lo[fi] = 0
+                seg_hi[fi] = nb - 1
+                pos_of_local[fi, :nb] = np.arange(nb)
+                pos = nb
+        col_bins[g] = pos
+    bb = int(col_bins.max())
+    local_of_pos = np.zeros((len(bundles), bb), np.int32)
+    for g, feats in enumerate(bundles):
+        for fi in feats:
+            for b in range(int(num_bins[fi])):
+                p = pos_of_local[fi, b]
+                if p >= 0:
+                    local_of_pos[g, p] = b
+    plan = EfbPlan(bundles, col_of_feat, seg_lo, seg_hi, is_multi,
+                   pos_of_local, local_of_pos, col_bins, len(bundles), bb)
+    Log.info("EFB: bundled %d features into %d columns (max %d bins)",
+             f, plan.num_cols, bb)
+    return plan
+
+
+def bundle_matrix(bins: np.ndarray, plan: EfbPlan) -> np.ndarray:
+    """Re-encode the [N, F] bin matrix as [N, Fb] bundle columns."""
+    n = bins.shape[0]
+    dtype = np.uint8 if plan.bundle_bmax <= 256 else np.uint16
+    out = np.zeros((n, plan.num_cols), dtype)
+    for g, feats in enumerate(plan.bundles):
+        if len(feats) == 1 and not plan.is_multi[feats[0]]:
+            out[:, g] = bins[:, feats[0]].astype(dtype)
+            continue
+        for fi in feats:
+            col = bins[:, fi].astype(np.int64)
+            pos = plan.pos_of_local[fi][col]            # [N]
+            active = pos >= 0
+            # conflicts (simultaneously active features) resolve to the
+            # later feature, within the accepted conflict budget
+            out[active, g] = pos[active].astype(dtype)
+    return out
+
+
+class EfbDev(NamedTuple):
+    """Device-side static tables. All fields are arrays so the tuple
+    rides through jit as a pytree; the static ints (Fb, Bb) are derived
+    from shapes, which stay concrete under tracing.
+
+    ``loc_table[f, p]`` is the COMPLETE routing story: the original local
+    bin of feature f when its bundle column holds position p (default
+    bin folded in for out-of-segment positions), so a row's bin on any
+    feature is one flat gather."""
+    col_of_feat: object             # [F] i32
+    seg_lo: object                  # [F] i32
+    seg_hi: object                  # [F] i32
+    flat_pos: object                # [F, bmax] i32 gather index (clipped)
+    is_default_pos: object          # [F, bmax] bool
+    is_valid_pos: object            # [F, bmax] bool
+    loc_table: object               # [F, Bb] i32
+    num_cols_arr: object            # [Fb] placeholder carrying Fb shape
+
+    @property
+    def num_cols(self) -> int:
+        return self.num_cols_arr.shape[0]
+
+    @property
+    def bundle_bmax(self) -> int:
+        return self.loc_table.shape[1]
+
+
+def make_device_tables(plan: EfbPlan, default_bins: np.ndarray) -> EfbDev:
+    import jax.numpy as jnp
+    f, bmax = plan.pos_of_local.shape
+    bb = plan.bundle_bmax
+    flat = plan.col_of_feat[:, None] * bb + np.clip(plan.pos_of_local, 0,
+                                                    bb - 1)
+    loc = np.empty((f, bb), np.int32)
+    for fi in range(f):
+        g = plan.col_of_feat[fi]
+        p = np.arange(bb)
+        in_seg = (p >= plan.seg_lo[fi]) & (p <= plan.seg_hi[fi])
+        loc[fi] = np.where(in_seg, plan.local_of_pos[g],
+                           default_bins[fi])
+    return EfbDev(
+        col_of_feat=jnp.asarray(plan.col_of_feat),
+        seg_lo=jnp.asarray(plan.seg_lo),
+        seg_hi=jnp.asarray(plan.seg_hi),
+        flat_pos=jnp.asarray(flat.astype(np.int32)),
+        is_default_pos=jnp.asarray(plan.pos_of_local == -1),
+        is_valid_pos=jnp.asarray(plan.pos_of_local >= 0),
+        loc_table=jnp.asarray(loc),
+        num_cols_arr=jnp.zeros(plan.num_cols, jnp.int8))
+
+
+def expand_histograms(hist_b, efb: EfbDev):
+    """[S, Fb, Bb, C] bundled histograms -> [S, F, bmax, C] per original
+    feature. Linear in the histogram, so it commutes with the
+    data-parallel psum. Default-bin mass is node_total - segment_sum
+    (exact up to the accepted conflict budget)."""
+    import jax.numpy as jnp
+    s, fb, bb, c = hist_b.shape
+    flat = hist_b.reshape(s, fb * bb, c)
+    gath = flat[:, efb.flat_pos]                        # [S, F, bmax, C]
+    csum = jnp.cumsum(hist_b, axis=2)                   # [S, Fb, Bb, C]
+    # every row lands in exactly one bin of every column, so any single
+    # column's total is the node total
+    total = jnp.sum(hist_b[:, 0], axis=1)               # [S, C]
+    hi_s = csum[:, efb.col_of_feat, efb.seg_hi]         # [S, F, C]
+    lo_gate = (efb.seg_lo > 0)[None, :, None]
+    lo_s = csum[:, efb.col_of_feat,
+                jnp.maximum(efb.seg_lo - 1, 0)] * lo_gate
+    dmass = total[:, None] - (hi_s - lo_s)              # [S, F, C]
+    out = jnp.where(efb.is_valid_pos[None, :, :, None], gath, 0.0)
+    out = jnp.where(efb.is_default_pos[None, :, :, None],
+                    dmass[:, :, None], out)
+    return out
+
+
+def route_bins(bins, pf, efb: EfbDev):
+    """Per-row ORIGINAL-feature local bin for rows' split feature pf.
+
+    bins: [N, Fb] bundled matrix; pf: [N] original feature id. The
+    loc_table already folds in the default bin for out-of-segment
+    positions (exclusivity)."""
+    import jax.numpy as jnp
+    g = efb.col_of_feat[pf]                             # [N]
+    binv = jnp.take_along_axis(bins, g[:, None],
+                               axis=1)[:, 0].astype(jnp.int32)
+    return efb.loc_table.reshape(-1)[pf * efb.bundle_bmax + binv]

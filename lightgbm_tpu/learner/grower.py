@@ -156,16 +156,24 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                      jax.Array]] = None,
               cegb_cfg: Optional[CegbParams] = None,
               cegb_state: Optional[Tuple[jax.Array, jax.Array, jax.Array]]
-              = None, monotone_method: str = "basic"):
+              = None, monotone_method: str = "basic", efb=None):
     """Grow one tree. grad/hess must already include bagging/objective
     weights (zeros for out-of-bag rows); `cnt_weight` is 1.0 for in-bag rows
     and 0.0 otherwise so min_data_in_leaf counts sampled rows only.
+
+    With `efb` (an efb.EfbDev), `bins` is the BUNDLED [N, Fb] matrix:
+    histograms build in bundle space and are expanded back to original
+    features before the scan, and routing translates through the bundle
+    tables — every other argument stays in original-feature space
+    (reference feature_group.h:25; see efb.py).
 
     Returns (tree, row_node) — row_node maps every row (in- and out-of-bag)
     to its leaf for learner-side score updates (reference
     score_updater.hpp:21-110 AddScore(tree_learner) path).
     """
-    n, f = bins.shape
+    n = bins.shape[0]
+    f = feature_mask.shape[0] if efb is not None else bins.shape[1]
+    hist_bmax = efb.bundle_bmax if efb is not None else bmax
     m = 2 * num_leaves - 1             # max nodes
     s = num_leaves + 1                 # frontier slots (2k children <= S)
     if max_passes <= 0:
@@ -308,11 +316,18 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             from .histogram_pallas import build_histograms_pallas
             hist = build_histograms_pallas(
                 bins, grad, hess, cnt_weight, row_slot, num_slots=s,
-                bmax=bmax)
+                bmax=hist_bmax)
         else:
             hist = build_histograms(bins, grad, hess, row_slot, cnt_weight,
-                                    num_slots=s, bmax=bmax,
+                                    num_slots=s, bmax=hist_bmax,
                                     feature_block=feature_block)
+        if efb is not None:
+            # bundle-space histograms -> per-original-feature histograms;
+            # everything downstream (scan, forced splits, monotone cache)
+            # is in original-feature space from here on. Linear, so the
+            # data-parallel psum below commutes with it.
+            from ..efb import expand_histograms
+            hist = expand_histograms(hist, efb)
         # ---- 2. best-split scan per slot (with collectives if parallel) ----
         sn = st.slot_nodes                                  # [S] (M=dummy)
         hist_cache = st.hist_cache
@@ -626,8 +641,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         pnode = st.row_node
         pm = split_mask[pnode]                               # [N]
         pf = jnp.clip(feat[pnode], 0, f - 1)
-        binv = jnp.take_along_axis(bins, pf[:, None], axis=1)[:, 0] \
-            .astype(jnp.int32)
+        if efb is not None:
+            from ..efb import route_bins
+            binv = route_bins(bins, pf, efb)
+        else:
+            binv = jnp.take_along_axis(bins, pf[:, None], axis=1)[:, 0] \
+                .astype(jnp.int32)
         thr = best.threshold_bin[pnode]
         isc = is_cat_feat[pf]
         is_nan_bin = missing_is_nan[pf] & (binv == num_bins[pf] - 1)

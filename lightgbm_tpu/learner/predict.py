@@ -23,9 +23,13 @@ __all__ = ["predict_binned_tree", "predict_binned_forest",
 
 
 def _traverse(tree: TreeArrays, bins: jax.Array, num_bins: jax.Array,
-              missing_is_nan: jax.Array) -> jax.Array:
-    """Return [N] leaf node id for each row."""
-    n, f = bins.shape
+              missing_is_nan: jax.Array, efb=None) -> jax.Array:
+    """Return [N] leaf node id for each row. With `efb`, bins is the
+    bundled [N, Fb] matrix and decisions translate through the bundle
+    tables (efb.py route_bins) — node semantics stay in original
+    feature space."""
+    n = bins.shape[0]
+    f = num_bins.shape[0]
 
     def cond(node):
         return jnp.any(tree.split_feature[node] >= 0)
@@ -34,8 +38,12 @@ def _traverse(tree: TreeArrays, bins: jax.Array, num_bins: jax.Array,
         feat = tree.split_feature[node]
         internal = feat >= 0
         fc = jnp.clip(feat, 0, f - 1)
-        binv = jnp.take_along_axis(bins, fc[:, None], axis=1)[:, 0] \
-            .astype(jnp.int32)
+        if efb is not None:
+            from ..efb import route_bins
+            binv = route_bins(bins, fc, efb)
+        else:
+            binv = jnp.take_along_axis(bins, fc[:, None], axis=1)[:, 0] \
+                .astype(jnp.int32)
         thr = tree.threshold_bin[node]
         isc = tree.is_cat[node]
         is_nan_bin = missing_is_nan[fc] & (binv == num_bins[fc] - 1)
@@ -55,17 +63,18 @@ def _traverse(tree: TreeArrays, bins: jax.Array, num_bins: jax.Array,
 @jax.jit
 def predict_binned_tree(tree: TreeArrays, bins: jax.Array,
                         num_bins: jax.Array,
-                        missing_is_nan: jax.Array) -> jax.Array:
+                        missing_is_nan: jax.Array,
+                        efb=None) -> jax.Array:
     """[N] leaf values of one tree."""
-    leaf = _traverse(tree, bins, num_bins, missing_is_nan)
+    leaf = _traverse(tree, bins, num_bins, missing_is_nan, efb)
     return tree.leaf_value[leaf]
 
 
 @jax.jit
 def leaf_node_tree(tree: TreeArrays, bins: jax.Array, num_bins: jax.Array,
-                   missing_is_nan: jax.Array) -> jax.Array:
+                   missing_is_nan: jax.Array, efb=None) -> jax.Array:
     """[N] leaf NODE id per row (for linear-leaf model lookup)."""
-    return _traverse(tree, bins, num_bins, missing_is_nan)
+    return _traverse(tree, bins, num_bins, missing_is_nan, efb)
 
 
 @jax.jit

@@ -54,7 +54,8 @@ def make_sharded_grower(mesh: Mesh, comm: CommSpec, *, num_leaves: int,
                         interaction_groups: Optional[tuple] = None,
                         feature_fraction_bynode: float = 1.0,
                         with_rng: bool = False, forced=None,
-                        cegb_cfg=None, with_cegb_state: bool = False):
+                        cegb_cfg=None, with_cegb_state: bool = False,
+                        efb=None):
     """Build a shard_map'ped grower with the given static config.
 
     use_mxu (data-parallel only) runs the MXU grower inside shard_map
@@ -89,7 +90,7 @@ def make_sharded_grower(mesh: Mesh, comm: CommSpec, *, num_leaves: int,
             monotone_method=monotone_method,
             interaction_groups=interaction_groups,
             feature_fraction_bynode=feature_fraction_bynode,
-            forced=forced, cegb_cfg=cegb_cfg)
+            forced=forced, cegb_cfg=cegb_cfg, efb=efb)
 
     # forced-split spec arrays are baked in as static closures (tree-wide
     # constants); CEGB state travels as a live argument because the
