@@ -110,24 +110,25 @@ def main():
               "min_data_in_leaf": 20}
     booster = lgb.Booster(params=params, train_set=dtrain)
 
-    # warmup: compile all jitted phases. Drain via an actual host transfer
-    # (block_until_ready is not reliable through remoted-accelerator
-    # tunnels; a device->host pull cannot complete before the queue does)
-    for _ in range(WARMUP_TREES):
-        booster.update()
+    # warmup: compile all jitted phases (incl. the fused multi-tree scan,
+    # boosting/fused.py — one device dispatch per block). Drain via an
+    # actual host transfer (block_until_ready is not reliable through
+    # remoted-accelerator tunnels; a device->host pull cannot complete
+    # before the queue does)
+    block_trees = min(BLOCK_TREES, BENCH_TREES)
+    booster.update_batch(max(1, WARMUP_TREES - 1))
+    booster.update_batch(block_trees)  # compile the bench-block shape
     float(np.asarray(booster.gbdt.train_score[:1])[0])
 
     # the remoted-accelerator tunnel has run-to-run variance of +-50%
     # (occasionally 3x, docs/PerfNotes.md); time several blocks and take
     # the best, the documented measurement methodology for this backend.
     # BENCH_TREES rounds to whole blocks (at least one).
-    block_trees = min(BLOCK_TREES, BENCH_TREES)
     n_blocks = max(1, round(BENCH_TREES / block_trees))
     block_times = []
     for _ in range(n_blocks):
         t1 = time.time()
-        for _ in range(block_trees):
-            booster.update()
+        booster.update_batch(block_trees)
         float(np.asarray(booster.gbdt.train_score[:1])[0])
         block_times.append(time.time() - t1)
     rates = sorted(block_trees / b for b in block_times)
@@ -156,7 +157,7 @@ def main():
     sc = booster.predict(Xva, raw_score=True)
     from lightgbm_tpu.metrics import AUCMetric  # tie-corrected, no scipy
     auc = AUCMetric._auc_fast(sc, yva > 0, np.ones_like(yva))
-    print(f"# held-out AUC after {WARMUP_TREES + n_blocks * block_trees} "
+    print(f"# held-out AUC after {booster.current_iteration()} "
           f"trees: {auc:.5f}", file=sys.stderr)
     print("# note: vs_baseline uses the reference's published 10.5M-row "
           "28-core Higgs rate; same-host single-core reference on THIS "

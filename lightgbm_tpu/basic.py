@@ -599,6 +599,16 @@ class Booster:
                 jnp.asarray(hess, jnp.float32).reshape(score.shape))
         return self.gbdt.train_one_iter()
 
+    def update_batch(self, num_iterations: int) -> bool:
+        """Run several boosting iterations with a single device dispatch
+        (the fused on-device scan, boosting/fused.py) when the
+        configuration allows, else a plain update() loop. Semantically
+        identical to calling update() num_iterations times; the win is
+        host-boundary amortization on remoted accelerators. Returns True
+        if training cannot continue."""
+        self._model = None
+        return self.gbdt.train_many(num_iterations)
+
     def rollback_one_iter(self) -> "Booster":
         self._model = None
         self.gbdt.rollback_one_iter()
@@ -796,6 +806,9 @@ class Booster:
         if self.gbdt is not None:
             self.gbdt.shrinkage_rate = float(self.config.learning_rate)
             self.gbdt.config = self.config
+            # the fused multi-tree scan bakes shrinkage/grower settings
+            # into its compiled closure — rebuild on next update_batch
+            self.gbdt._fused_run = None
         return self
 
     def __copy__(self):

@@ -72,7 +72,8 @@ class DART(GBDT):
         idx = it * k + cls
         tree = self.trees[idx]
         lin = self._lin(idx)
-        vals = self._tree_values(tree, lin, self.bins, self.raw,
+        vals = self._tree_values(tree, lin, self._train_bins_unpacked(),
+                                 self.raw,
                                  self._efb)[:self.num_data] * factor
         if k == 1:
             self.train_score = self.train_score + vals
@@ -115,7 +116,8 @@ class DART(GBDT):
             lin = self._lin(idx)
             if new_factor != 1.0:
                 # remove over-counted part from scores
-                vals = self._tree_values(tree, lin, self.bins,
+                vals = self._tree_values(tree, lin,
+                                         self._train_bins_unpacked(),
                                          self.raw, self._efb) \
                     [:self.num_data] * (new_factor - 1.0)
                 cls_id = self.tree_class[idx]

@@ -1,0 +1,69 @@
+"""Fused multi-tree training: K boosting iterations per device dispatch.
+
+The reference's training loop crosses the host boundary every iteration
+(gbdt.cpp:371 TrainOneIter, driven from Python via
+LGBM_BoosterUpdateOneIter) — cheap on a local device, but on a remoted
+accelerator every crossing pays dispatch/sync latency comparable to the
+tree compute itself (measured ~100 ms/tree through the tunnel,
+docs/PerfNotes.md round 3). The TPU-native reformulation: the boosting
+loop itself is a `lax.scan` whose body grows one tree — objective
+gradients, quantization, growth, prune, exact leaf refit and the score
+update all stay on device — so the host sees ONE dispatch per K trees
+and receives the K stacked TreeArrays plus the advanced scores.
+
+Eligibility is decided by the caller (GBDT.train_many): serial MXU
+growth path, plain gbdt boosting, single tree per iteration, no bagging
+/ GOSS, no validation-score replay, no L1-family leaf renewal — every
+excluded feature falls back to the per-iteration path unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["build_fused_train"]
+
+
+def build_fused_train(*, objective, bins, cnt_weight, feature_mask_fn,
+                      num_bins, missing_is_nan, is_cat, grower_kwargs,
+                      shrinkage: float, extra_seed: int, needs_rng: bool,
+                      interpret: bool = False):
+    """Return run(score, it0, k) -> (score', stacked TreeArrays).
+
+    `objective.get_gradients` must be pure jnp (all built-in objectives
+    are); `grower_kwargs` are the static grow_tree_mxu settings;
+    `feature_mask_fn(it)` produces the per-iteration feature_fraction
+    mask (traced iteration index).
+    """
+    from ..learner.grower_mxu import grow_tree_mxu
+    from ..learner.histogram_mxu import node_values_mxu
+
+    shrink = jnp.float32(shrinkage)
+
+    def body(score, it):
+        grad, hess = objective.get_gradients(score)
+        fmask = feature_mask_fn(it)
+        rng = jax.random.fold_in(jax.random.PRNGKey(extra_seed), it) \
+            if needs_rng else None
+        tree, row_node = grow_tree_mxu(
+            bins, grad, hess, cnt_weight, fmask, num_bins,
+            missing_is_nan, is_cat, rng_key=rng, interpret=interpret,
+            **grower_kwargs)
+        # device-side stand-in for the "no further splits" break: a tree
+        # that made no split becomes all-zero and the scan carries on
+        # (train_one_iter's ok-zeroing, gbdt.py)
+        ok = (tree.num_leaves > 1).astype(jnp.float32)
+        tree = tree._replace(leaf_value=tree.leaf_value * (shrink * ok))
+        vals = node_values_mxu(row_node, tree.leaf_value,
+                               interpret=interpret)
+        return score + vals, tree
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def run(score, it0, *, k: int):
+        its = jnp.asarray(it0, jnp.int32) + jnp.arange(k, dtype=jnp.int32)
+        return jax.lax.scan(body, score, its)
+
+    return run

@@ -56,8 +56,9 @@ class GBDT:
         self.trees: List[TreeArrays] = []       # flat: iter*K + class
         self.tree_class: List[int] = []
         self.linear_models: List = []           # LinearLeaves or None, per tree
-        self._pending_nleaves = None            # device scalar, lag-1 poll
+        self._pending_nleaves = None            # device scalar, lagged poll
         self._exact_stop_poll = False
+        self._stop_poll_every = 8               # host-sync amortization
         self.models_meta: List[dict] = []       # host-side per-tree info
         self.valid_sets: List[BinnedDataset] = []
         self.valid_names: List[str] = []
@@ -191,6 +192,26 @@ class GBDT:
             Log.warning("use_quantized_grad only accelerates the MXU "
                         "growth path (active: %s); training runs "
                         "full-precision", self._hist_impl)
+        # 4-bit packed bin storage (reference dense_bin.hpp:42): when
+        # every feature fits a nibble, re-upload the bin matrix packed
+        # two-features-per-byte; the MXU kernels unpack in VMEM. Exact.
+        self._packed4 = False
+        if (self._hist_impl == "mxu" and cfg.bin_pack_4bit and
+                self.bmax <= 16 and not cfg.linear_tree):
+            from ..learner.histogram_mxu import (fits_v2, pack_bins_4bit)
+            # packing only pays when every growth pass stays on the
+            # fused/v2 kernels (VMEM-resident histograms); the v1
+            # wide-feature fallback would unpack the whole matrix per
+            # call — worse than unpacked storage
+            L_g = int(np.ceil(cfg.num_leaves * cfg.growth_overshoot)) \
+                if cfg.growth_overshoot >= 1.0 else cfg.num_leaves
+            if fits_v2(L_g + 1, ds.num_features, self.bmax,
+                       cfg.gpu_use_dp, cfg.use_quantized_grad):
+                self.bins = None  # free the unpacked device copy first
+                self.bins = jnp.asarray(pack_bins_4bit(ds.bins))
+                self._packed4 = True
+                Log.debug("bin matrix packed 4-bit: [%d, %d] bytes",
+                          ds.num_data, self.bins.shape[1])
         # linear trees (reference LinearTreeLearner; raw values required,
         # dataset.cpp:418-420)
         self._linear = bool(cfg.linear_tree)
@@ -453,7 +474,8 @@ class GBDT:
                 tail_split_cap=cfg.tail_split_cap,
                 hist_subtraction=cfg.hist_subtraction,
                 overshoot=cfg.growth_overshoot,
-                quantized_grad=cfg.use_quantized_grad)
+                quantized_grad=cfg.use_quantized_grad,
+                packed4=self._packed4)
         if self._grower is None:
             out = grow_tree(
                 self.bins, g, h, cnt, feature_mask, self.num_bins_d,
@@ -523,10 +545,18 @@ class GBDT:
         new_lv = np.where(is_leaf, synced, lv).astype(np.float32)
         return tree._replace(leaf_value=jnp.asarray(new_lv))
 
+    def _train_bins_unpacked(self) -> jax.Array:
+        """Training bin matrix in unpacked [N, F] form for cold paths
+        (rollback, DART drops) — transient device unpack when packed."""
+        if not getattr(self, "_packed4", False):
+            return self.bins
+        from ..learner.histogram_mxu import unpack_bins_4bit
+        return unpack_bins_4bit(self.bins, int(self.num_bins_d.shape[0]))
+
     def _predict_train_rows(self, tree: TreeArrays) -> jax.Array:
         """Tree outputs for the (unpadded) training rows."""
         bins = self._local_bins if getattr(self, "_nproc", 1) > 1 \
-            else self.bins
+            else self._train_bins_unpacked()
         vals = predict_binned_tree(tree, bins, self.num_bins_d,
                                    self.missing_is_nan_d, self._efb)
         return vals[:self.num_data] if self._row_pad else vals
@@ -649,24 +679,36 @@ class GBDT:
                 feature_mask = self._feature_mask()
                 tree, row_node = self._grow(g, h, cnt, feature_mask)
             # a host pull of num_leaves costs a full device round-trip
-            # (~hundreds of ms through a remoted accelerator). Instead of
-            # syncing on the fresh tree, the stop decision reads the
-            # PREVIOUS iteration's count (its pull overlaps this
-            # iteration's device work). The fresh tree always takes the
+            # (~hundreds of ms through a remoted accelerator, ready or
+            # not). Instead of syncing on the fresh tree, the stop
+            # decision reads a PREVIOUS iteration's count, and even that
+            # only every _stop_poll_every iterations — each stored count
+            # starts an async D2H copy so the eventual int() finds the
+            # value already on the host. The fresh tree always takes the
             # normal processing branch — shrinkage, score update, and the
             # device-side `ok` zeroing make a genuine no-split tree a
             # harmless all-zero tree, while a real tree (possible after a
             # dry iteration when bagging resamples) stays fully applied.
-            # Subclasses that average over iteration count (RF) set
-            # _exact_stop_poll to keep the reference's immediate stop.
+            # Stall detection is therefore delayed by up to
+            # _stop_poll_every iterations (the extra trees are all-zero —
+            # predictions unaffected). Subclasses that average over
+            # iteration count (RF) set _exact_stop_poll to keep the
+            # reference's immediate stop.
             if len(self.trees) < k or self._exact_stop_poll:
                 nleaves = int(tree.num_leaves)
                 stop_hint = nleaves <= 1
             else:
                 prev = self._pending_nleaves
-                stop_hint = prev is not None and int(prev) <= 1
+                stop_hint = (prev is not None and
+                             self.iter_ % self._stop_poll_every == 0 and
+                             int(prev) <= 1)
                 nleaves = 2
-            self._pending_nleaves = tree.num_leaves
+            pending = tree.num_leaves
+            try:
+                pending.copy_to_host_async()
+            except Exception:
+                pass
+            self._pending_nleaves = pending
             lin = None
             if nleaves > 1:
                 if not stop_hint:
@@ -730,16 +772,113 @@ class GBDT:
         return not should_continue
 
     def _feature_mask(self) -> jax.Array:
+        return self._feature_mask_at(self.iter_)
+
+    def _feature_mask_at(self, it) -> jax.Array:
+        """Per-iteration feature_fraction mask; `it` may be a traced
+        iteration index (the fused multi-tree scan)."""
         cfg = self.config
         f = int(self.num_bins_d.shape[0])  # original features (not Fb)
         if cfg.feature_fraction >= 1.0:
             return jnp.ones(f, jnp.float32)
         key = jax.random.fold_in(
-            jax.random.PRNGKey(cfg.feature_fraction_seed), self.iter_)
+            jax.random.PRNGKey(cfg.feature_fraction_seed), it)
         kf = max(1, int(round(f * cfg.feature_fraction)))
         perm = jax.random.permutation(key, f)
         mask = jnp.zeros(f, jnp.float32).at[perm[:kf]].set(1.0)
         return mask
+
+    # ------------------------------------------------------------------
+    # fused multi-tree training (TPU pipelining; boosting/fused.py)
+    def _fused_eligible(self) -> bool:
+        """Whether K iterations can run as one on-device scan with
+        behavior identical to K train_one_iter calls."""
+        cfg = self.config
+        needs_bagging = cfg.bagging_freq > 0 and (
+            cfg.bagging_fraction < 1.0 or cfg.pos_bagging_fraction < 1.0
+            or cfg.neg_bagging_fraction < 1.0)
+        return (type(self) is GBDT and cfg.boosting == "gbdt"
+                and self._grower is None and self._hist_impl == "mxu"
+                and self.num_tree_per_iteration == 1
+                and not self.valid_sets and not self._linear
+                and self.objective is not None
+                and not self.objective.need_renew_tree_output
+                and not needs_bagging
+                and self._forced is None and self._cegb_cfg is None)
+
+    def _build_fused(self):
+        from .fused import build_fused_train
+        cfg = self.config
+        grower_kwargs = dict(
+            num_leaves=cfg.num_leaves, max_depth=cfg.max_depth,
+            hp=self.hp, bmax=self.bmax, monotone=self._monotone,
+            interaction_groups=self._interaction_groups,
+            feature_fraction_bynode=cfg.feature_fraction_bynode,
+            hist_double_prec=cfg.gpu_use_dp,
+            tail_split_cap=cfg.tail_split_cap,
+            hist_subtraction=cfg.hist_subtraction,
+            overshoot=cfg.growth_overshoot,
+            quantized_grad=cfg.use_quantized_grad,
+            packed4=self._packed4)
+        needs_rng = (cfg.feature_fraction_bynode < 1.0 or cfg.extra_trees
+                     or cfg.use_quantized_grad)
+        return build_fused_train(
+            objective=self.objective, bins=self.bins,
+            cnt_weight=jnp.ones(self.num_data, jnp.float32),
+            feature_mask_fn=self._feature_mask_at,
+            num_bins=self.num_bins_d, missing_is_nan=self.missing_is_nan_d,
+            is_cat=self.is_cat_d, grower_kwargs=grower_kwargs,
+            shrinkage=self.shrinkage_rate, extra_seed=cfg.extra_seed,
+            needs_rng=needs_rng,
+            interpret=getattr(self, "_mxu_interpret", False))
+
+    def train_many(self, k: int) -> bool:
+        """K boosting iterations with one device dispatch (and at most
+        one amortized host sync) — behavior-identical to K
+        train_one_iter calls when eligible, else a plain loop. Returns
+        True when training cannot continue (lagged stall detection, as
+        in train_one_iter)."""
+        if self.iter_ == 0 and k > 0:
+            # the first iteration owns boost_from_average / init-score
+            # plumbing (host-side floats); run it on the normal path
+            if self.train_one_iter():
+                return True
+            k -= 1
+        if k <= 0:
+            return False
+        if not self._fused_eligible():
+            for _ in range(k):
+                if self.train_one_iter():
+                    return True
+            return False
+        if getattr(self, "_fused_run", None) is None:
+            self._fused_run = self._build_fused()
+        with global_timer.timeit("tree_train"):
+            score, stacked = self._fused_run(
+                self.train_score, jnp.asarray(self.iter_, jnp.int32), k=k)
+        self.train_score = score
+        for i in range(k):
+            self.trees.append(
+                jax.tree_util.tree_map(lambda a: a[i], stacked))
+            self.tree_class.append(0)
+            self.linear_models.append(None)
+        self.iter_ += k
+        # lagged stall poll (see train_one_iter): a stalled model keeps
+        # producing all-zero trees, so checking the batch's last tree
+        # roughly every _stop_poll_every ITERATIONS is enough — poll
+        # when this batch crossed a poll boundary, whatever its size
+        prev = self._pending_nleaves
+        crossed = (self.iter_ // self._stop_poll_every !=
+                   (self.iter_ - k) // self._stop_poll_every)
+        stop_hint = (prev is not None and not self._exact_stop_poll and
+                     crossed and int(prev) <= 1)
+        pending = stacked.num_leaves[k - 1]
+        try:
+            pending.copy_to_host_async()
+        except Exception:
+            pass
+        self._pending_nleaves = pending
+        return stop_hint
 
     def _constant_tree(self, value: float) -> TreeArrays:
         m1 = 2 * self.config.num_leaves - 1 + 1
@@ -853,8 +992,9 @@ class GBDT:
             if lin is None:
                 vals = self._predict_train_rows(tree)
             else:
-                vals = self._tree_values(tree, lin, self.bins, self.raw,
-                                         self._efb)[:self.num_data]
+                vals = self._tree_values(tree, lin,
+                                         self._train_bins_unpacked(),
+                                         self.raw, self._efb)[:self.num_data]
             if k == 1:
                 self.train_score = self.train_score - vals
             else:

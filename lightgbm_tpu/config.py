@@ -257,6 +257,12 @@ _PARAMS: List[ParamSpec] = [
        "many splits per pass before re-ranking (approaches the "
        "reference's strict best-first order, serial_tree_learner.cpp:159, "
        "as the cap shrinks). 0 = unthrottled batched growth"),
+    _p("bin_pack_4bit", bool, True, ("four_bit_bins",),
+       desc="store the device bin matrix two-features-per-byte when "
+            "every feature fits 4 bits (max_bin <= 15; the reference's "
+            "4-bit DenseBin, src/io/dense_bin.hpp:42). Kernels unpack "
+            "nibbles in VMEM — halves bin-matrix HBM with identical "
+            "trees. Serial MXU growth path only"),
     _p("use_quantized_grad", bool, False, ("quantized_grad",),
        desc="stochastically-rounded integer gradients/hessians for the "
             "MXU histogram kernels (3 channels instead of 5, ~1.5x "

@@ -182,7 +182,8 @@ def _select_rows(onehot: jax.Array, table: jax.Array) -> jax.Array:
                      "interaction_groups", "feature_fraction_bynode",
                      "interpret", "hist_double_prec", "tail_split_cap",
                      "hist_subtraction", "overshoot", "psum_axis",
-                     "quantized_grad", "use_scan_kernel", "debug_info"))
+                     "quantized_grad", "use_scan_kernel", "packed4",
+                     "debug_info"))
 def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   cnt_weight: jax.Array, feature_mask: jax.Array,
                   num_bins: jax.Array, missing_is_nan: jax.Array,
@@ -200,6 +201,7 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   psum_axis: Optional[str] = None,
                   quantized_grad: bool = False,
                   use_scan_kernel: bool = False,
+                  packed4: bool = False,
                   debug_info: bool = False
                   ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree; same contract as grower.grow_tree (serial mode).
@@ -220,8 +222,15 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     pass's scan tensor by an exact one-hot matmul. Nodes split later than
     the pass that scanned them (stale parents) get both children built
     (2 slots), and split selection is throttled so the per-pass slot cost
-    fits the kernel capacity (~s/2 instead of s slots per pass)."""
-    n, f = bins.shape
+    fits the kernel capacity (~s/2 instead of s slots per pass).
+
+    packed4=True marks `bins` as 4-bit packed storage (pack_bins_4bit,
+    the reference's 4-bit DenseBin, src/io/dense_bin.hpp:42): the kernels
+    unpack nibbles in VMEM, so HBM holds half the bin bytes. Exact —
+    identical trees to unpacked storage."""
+    n = bins.shape[0]
+    f = int(num_bins.shape[0]) if packed4 else bins.shape[1]
+    nf_packed = f if packed4 else 0
     # overshoot > 1 switches to overgrow-and-prune: grow toward
     # overshoot*num_leaves leaves with unthrottled batched passes, then
     # replay the exact best-first selection over the recorded gains
@@ -335,14 +344,17 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 bins, h_grad, h_hess, cnt_weight, row_node, tbl_c,
                 member_c, feat_tbl, num_slots=nslots, bmax=bmax,
                 has_cat=hp.has_categorical, quantized=quant,
-                double_prec=hist_double_prec, interpret=interpret)
+                double_prec=hist_double_prec, num_features=nf_packed,
+                interpret=interpret)
         else:
             rn, rs = route_rows_mxu(bins, row_node, tbl_c, member_c,
-                                    feat_tbl, interpret=interpret)
+                                    feat_tbl, num_features=nf_packed,
+                                    interpret=interpret)
             h = build_histograms_mxu_auto(
                 bins, h_grad, h_hess, cnt_weight, rs, num_slots=nslots,
                 bmax=bmax, interpret=interpret, quantized=quant,
-                double_prec=hist_double_prec, **hist_cfg(nslots))
+                double_prec=hist_double_prec, num_features=nf_packed,
+                **hist_cfg(nslots))
         if quant:
             h = h * hist_scale  # integer sums -> gradient units
         return _allred(h), rn
@@ -644,8 +656,20 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # tail passes are per-pass-floor bound; with a hybrid-growth cap the
     # frontier only ever holds 2*cap fresh children, so shrink the fixup
     # scan capacity accordingly
-    s_fix = min(64, s_max) if tail_split_cap <= 0 \
-        else min(s_max, max(16, 2 * tail_split_cap))
+    # NOTE a coverage gate here (stop fixups once num_leaves >= target,
+    # letting the prune work with schedule-only coverage) was measured
+    # at +0.85 trees/s but -0.0035 AUC@95 — the replay regularly KEEPS
+    # fixup-grown splits, so overshoot quality needs the full chase.
+    # Instead the overshoot fixup frontier is widened (128 vs 64): the
+    # same leftover splits commit in roughly half the passes (throttled
+    # trees late in boosting ran 10+ narrow fixup sweeps, decaying
+    # 2.09 -> 1.70 trees/s over 95 trees).
+    if over:
+        s_fix = min(128, s_max)
+    elif tail_split_cap <= 0:
+        s_fix = min(64, s_max)
+    else:
+        s_fix = min(s_max, max(16, 2 * tail_split_cap))
     k_fix = max(1, s_fix // 2)
     sk_fix = _kernel_cap(s_fix) if hist_subtraction else None
     if schedule:
@@ -669,7 +693,8 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # flush the routing of the last pass's splits (sweeps route at the
     # START of a pass, so the final commits have not moved rows yet)
     row_node, _ = route_rows_mxu(bins, state[1], state[2], state[3],
-                                 feat_tbl, interpret=interpret)
+                                 feat_tbl, num_features=nf_packed,
+                                 interpret=interpret)
     tree_out = state[0]
     cmin, cmax = state[6], state[7]
     if over:

@@ -37,13 +37,14 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as _np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["build_histograms_mxu", "build_histograms_mxu_v2",
            "build_histograms_mxu_auto", "route_rows_mxu",
            "pack_route_tables", "node_values_mxu", "node_sums_mxu",
-           "quantize_gradients"]
+           "quantize_gradients", "pack_bins_4bit", "unpack_bins_4bit"]
 
 # v5e has 128 MB VMEM; the default 16 MB scoped limit starves the
 # accumulate-in-VMEM histogram output on small row counts
@@ -57,6 +58,53 @@ _FGROUP = 4
 
 def _round_up(x: int, k: int) -> int:
     return ((x + k - 1) // k) * k
+
+
+# ---------------------------------------------------------------------------
+# 4-bit packed bin storage (reference 4-bit DenseBin, src/io/dense_bin.hpp:42)
+# ---------------------------------------------------------------------------
+
+def pack_bins_4bit(bins):
+    """Pack a [N, F] bin matrix whose values all fit 4 bits (max_bin <= 15
+    incl. the NaN bin) into [N, ceil(F/2)] uint8: feature j < Fh rides
+    column j's LOW nibble, feature Fh+j its HIGH nibble. The split layout
+    (features [0..Fh) low, [Fh..F) high — NOT interleaved nibbles) keeps
+    per-feature extraction a static column pick + shift/mask inside the
+    kernels, with no lane interleave. Accepts numpy or jax input; exact:
+    training on packed storage grows bit-identical trees."""
+    xp = jnp if isinstance(bins, jax.Array) else _np
+    n, f = bins.shape
+    fh = (f + 1) // 2
+    lo = bins[:, :fh].astype(xp.uint8)
+    hi = xp.zeros((n, fh), xp.uint8)
+    if f > fh:
+        if xp is jnp:
+            hi = hi.at[:, :f - fh].set(bins[:, fh:].astype(xp.uint8))
+        else:
+            hi[:, :f - fh] = bins[:, fh:].astype(xp.uint8)
+    return lo | (hi << 4)
+
+
+def unpack_bins_4bit(packed, num_features: int):
+    """Inverse of pack_bins_4bit -> [N, num_features] uint8."""
+    xp = jnp if isinstance(packed, jax.Array) else _np
+    fh = packed.shape[1]
+    lo = packed & xp.uint8(15)
+    hi = packed >> 4
+    return xp.concatenate([lo, hi], axis=1)[:, :num_features]
+
+
+def _packed_cols(bins_i, js, fh: int):
+    """Per-feature [nb, 1] i32 bin values from a packed i32 block for the
+    static feature ids `js` (kernel-side unpack: column pick + nibble)."""
+    out = []
+    for j in js:
+        if j < fh:
+            out.append(jnp.bitwise_and(bins_i[:, j:j + 1], 15))
+        else:
+            c = j - fh
+            out.append(jnp.right_shift(bins_i[:, c:c + 1], 4) & 15)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -190,10 +238,11 @@ def _combine_hist(out, *, nchan: int, s: int, f: int, b: int, bmax: int,
 
 
 def _hist_accumulate(hist_ref, slot, bins_i, data, *, nb: int, f: int,
-                     b: int, s: int, nchan: int, mm_dtype):
+                     b: int, s: int, nchan: int, mm_dtype, fh: int = 0):
     """Shared accumulation body of the v2/fused kernels: slot-masked
     channel operand, per-feature-group bin one-hots, accumulating dots.
-    slot: [nb, 1] i32 (-1 = no slot); bins_i: [nb, lanes] i32."""
+    slot: [nb, 1] i32 (-1 = no slot); bins_i: [nb, lanes] i32 (fh > 0:
+    4-bit packed columns, feature j at column j % fh, nibble j // fh)."""
     iota_s = jax.lax.broadcasted_iota(jnp.int32, (nb, s), 1)
     slot_oh = (slot == iota_s)                               # [nb, S] bool
     lhs = jnp.concatenate(
@@ -202,8 +251,10 @@ def _hist_accumulate(hist_ref, slot, bins_i, data, *, nb: int, f: int,
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (nb, b), 1)
     for gj in range(0, f, _FGROUP):
         js = range(gj, min(gj + _FGROUP, f))
+        cols = _packed_cols(bins_i, js, fh) if fh else \
+            [bins_i[:, j:j + 1] for j in js]
         oh = jnp.concatenate(
-            [(bins_i[:, j:j + 1] == iota_b) for j in js],
+            [(c == iota_b) for c in cols],
             axis=1).astype(mm_dtype)                         # [nb, G*B]
         part = jax.lax.dot_general(
             lhs, oh, dimension_numbers=(((0,), (0,)), ((), ())),
@@ -212,10 +263,13 @@ def _hist_accumulate(hist_ref, slot, bins_i, data, *, nb: int, f: int,
 
 
 def _route_decide(node, gath, bins_blk, ftbl, memb, *, nb: int,
-                  lanes: int):
+                  fh: int = 0):
     """Shared split-decision math of the route/fused kernels: numerical
     thresholds, NaN-bin default direction, categorical bitset membership.
-    gath: [nb, K] node-table row per row; bins_blk: [nb, lanes] f32;
+    gath: [nb, K] node-table row per row; bins_blk: [nb, lanes] f32
+    (fh > 0: 4-bit packed byte columns, feature j at column j % fh,
+    nibble j // fh — byte values <= 255 stay f32-exact, the nibble is
+    recovered arithmetically after the column pick);
     memb: [nb, Bpad] categorical left-set membership or None when the
     table holds no categorical splits. Returns new node ids [nb, 1] f32
     (rows of unsplit nodes keep their node)."""
@@ -230,13 +284,26 @@ def _route_decide(node, gath, bins_blk, ftbl, memb, *, nb: int,
     child_l = col(_COL_LEFT_Q) * 256.0 + col(_COL_LEFT_R)
     child_r = col(_COL_RIGHT_Q) * 256.0 + col(_COL_RIGHT_R)
 
-    # column select: binv[r] = bins[r, pf[r]] via one-hot mask-sum
-    iota_f = jax.lax.broadcasted_iota(jnp.int32, (nb, lanes), 1) \
-        .astype(jnp.float32)
+    if fh:
+        # packed storage: pick the byte column pf % fh, then the nibble
+        fh_f = jnp.float32(fh)
+        is_hi = jnp.where(pf >= fh_f, jnp.float32(1.0), jnp.float32(0.0))
+        pcol = pf - is_hi * fh_f
+        iota_p = jax.lax.broadcasted_iota(
+            jnp.int32, (nb, bins_blk.shape[1]), 1).astype(jnp.float32)
+        pbyte = jnp.sum(jnp.where(pcol == iota_p, bins_blk, 0.0),
+                        axis=1, keepdims=True)               # [nb, 1] f32
+        hi_val = jnp.floor(pbyte * jnp.float32(1.0 / 16.0))
+        binv = is_hi * hi_val + (1.0 - is_hi) * (pbyte - 16.0 * hi_val)
+    # per-feature flags (num_bins, missing_is_nan) index the full-width
+    # feature table regardless of bin packing
+    iota_f = jax.lax.broadcasted_iota(
+        jnp.int32, (nb, ftbl.shape[0]), 1).astype(jnp.float32)
     feat_oh = (pf == iota_f)                                 # [nb, L] bool
-    binv = jnp.sum(jnp.where(feat_oh, bins_blk, 0.0), axis=1,
-                   keepdims=True)                            # [nb, 1] f32
-    # per-feature flags (num_bins, missing_is_nan), same mask
+    if not fh:
+        # column select: binv[r] = bins[r, pf[r]] via one-hot mask-sum
+        binv = jnp.sum(jnp.where(feat_oh, bins_blk, 0.0), axis=1,
+                       keepdims=True)                        # [nb, 1] f32
     nbins = jnp.sum(jnp.where(feat_oh, ftbl[:, 0][None, :], 0.0),
                     axis=1, keepdims=True)
     mnan = jnp.sum(jnp.where(feat_oh, ftbl[:, 1][None, :], 0.0),
@@ -265,7 +332,7 @@ def _route_decide(node, gath, bins_blk, ftbl, memb, *, nb: int,
 
 
 def _hist_kernel_v2(nb: int, f: int, b: int, s: int,
-                    mm_dtype=jnp.bfloat16, nchan: int = 5):
+                    mm_dtype=jnp.bfloat16, nchan: int = 5, fh: int = 0):
     """Extraction-free histogram kernel: the [flane, fc*B] selector matmul
     of _hist_kernel (whose cost scales with the 128-lane padding, ~4.6x
     waste at F=28 and the S-independent floor of every pass) is replaced
@@ -284,7 +351,7 @@ def _hist_kernel_v2(nb: int, f: int, b: int, s: int,
             _hist_accumulate(out_ref, slot_ref[:],
                              bins_ref[:].astype(jnp.int32), data_ref[:],
                              nb=nb, f=f, b=b, s=s, nchan=nchan,
-                             mm_dtype=mm_dtype)
+                             mm_dtype=mm_dtype, fh=fh)
 
     return kernel
 
@@ -393,7 +460,7 @@ def fits_v2(num_slots: int, num_features: int, bmax: int,
 @functools.partial(
     jax.jit, static_argnames=("num_slots", "bmax", "row_block",
                               "interpret", "use_f32", "double_prec",
-                              "quantized"))
+                              "quantized", "num_features"))
 def build_histograms_mxu_v2(bins: jax.Array, grad: jax.Array,
                             hess: jax.Array, cnt: jax.Array,
                             row_slot: jax.Array, *, num_slots: int,
@@ -401,23 +468,30 @@ def build_histograms_mxu_v2(bins: jax.Array, grad: jax.Array,
                             use_f32: bool = False,
                             double_prec: bool = True,
                             quantized: bool = False,
+                            num_features: int = 0,
                             interpret: bool = False) -> jax.Array:
     """Extraction-free variant of build_histograms_mxu (same contract):
     one grid pass over rows, per-feature static lane slices instead of
-    the selector matmul, all channels in a single dot per feature."""
-    n, f = bins.shape
+    the selector matmul, all channels in a single dot per feature.
+
+    num_features > 0 marks `bins` as 4-bit packed storage
+    (pack_bins_4bit) with that many logical features; the kernel unpacks
+    nibbles in VMEM, halving the bin matrix's HBM traffic."""
+    n, fcols = bins.shape
+    f = num_features if num_features else fcols
+    fh = fcols if num_features else 0
     nb = row_block
     s = num_slots
     b = ((bmax + 127) // 128) * 128
-    flane = ((f + 127) // 128) * 128
+    flane = ((fcols + 127) // 128) * 128
 
     npad = (-n) % nb
     if npad:
         bins = jnp.pad(bins, ((0, npad), (0, 0)))
-    if flane != f:
+    if flane != fcols:
         # padded lanes are never sliced by the kernel (j < f); the value
         # only needs to be in-range for the int cast
-        bins = jnp.pad(bins, ((0, 0), (0, flane - f)))
+        bins = jnp.pad(bins, ((0, 0), (0, flane - fcols)))
     slot = jnp.where((row_slot < 0) | (row_slot >= s), -1, row_slot) \
         .astype(jnp.int32)
     if npad:
@@ -442,7 +516,7 @@ def build_histograms_mxu_v2(bins: jax.Array, grad: jax.Array,
     out = pl.pallas_call(
         _hist_kernel_v2(nb, f, b, s,
                         jnp.float32 if use_f32 else jnp.bfloat16,
-                        nchan=nchan),
+                        nchan=nchan, fh=fh),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((1, nchan * s, f * b), jnp.float32),
         interpret=interpret,
@@ -455,15 +529,20 @@ def build_histograms_mxu_v2(bins: jax.Array, grad: jax.Array,
 
 def build_histograms_mxu_auto(bins, grad, hess, cnt, row_slot, *,
                               num_slots, bmax, double_prec=True,
-                              quantized=False, interpret=False, **v1_cfg):
+                              quantized=False, num_features=0,
+                              interpret=False, **v1_cfg):
     """v2 kernel when its per-feature output block fits VMEM, else the
-    chunked v1 kernel (wide-feature datasets)."""
-    f = bins.shape[1]
+    chunked v1 kernel (wide-feature datasets). num_features > 0 marks
+    `bins` as 4-bit packed (the v1 fallback unpacks on device — packed
+    storage targets small-bmax shapes, which always fit v2)."""
+    f = num_features if num_features else bins.shape[1]
     if fits_v2(num_slots, f, bmax, double_prec, quantized):
         return build_histograms_mxu_v2(
             bins, grad, hess, cnt, row_slot, num_slots=num_slots,
             bmax=bmax, double_prec=double_prec, quantized=quantized,
-            interpret=interpret)
+            num_features=num_features, interpret=interpret)
+    if num_features:
+        bins = unpack_bins_4bit(bins, num_features)
     return build_histograms_mxu(
         bins, grad, hess, cnt, row_slot, num_slots=num_slots, bmax=bmax,
         double_prec=double_prec, quantized=quantized, interpret=interpret,
@@ -472,7 +551,7 @@ def build_histograms_mxu_auto(bins, grad, hess, cnt, row_slot, *,
 
 def _fused_kernel(nb: int, f: int, flane: int, b: int, s: int, m: int,
                   bpad: int, mm_dtype=jnp.bfloat16, nchan: int = 5,
-                  has_cat: bool = True):
+                  has_cat: bool = True, fh: int = 0):
     """Route + histogram in ONE sweep over the binned matrix: advance each
     row through the splits committed by the previous pass (the
     _route_kernel math) and immediately scatter-accumulate it into its new
@@ -520,7 +599,7 @@ def _fused_kernel(nb: int, f: int, flane: int, b: int, s: int, m: int,
             new_node_f = _route_decide(
                 node, gath, bins_ref[:].astype(jnp.int32)
                 .astype(jnp.float32), feat_tbl_ref[:], memb,
-                nb=nb, lanes=flane)
+                nb=nb, fh=fh)
             node_out_ref[:] = new_node_f.astype(jnp.int32)
 
         # ---- histogram accumulation for every block holding slotted
@@ -541,20 +620,22 @@ def _fused_kernel(nb: int, f: int, flane: int, b: int, s: int, m: int,
             _hist_accumulate(hist_ref, slot,
                              bins_ref[:].astype(jnp.int32), data_ref[:],
                              nb=nb, f=f, b=b, s=s, nchan=nchan,
-                             mm_dtype=mm_dtype)
+                             mm_dtype=mm_dtype, fh=fh)
 
     return kernel
 
 
 @functools.partial(
     jax.jit, static_argnames=("num_slots", "bmax", "row_block", "has_cat",
-                              "double_prec", "quantized", "interpret"))
+                              "double_prec", "quantized", "num_features",
+                              "interpret"))
 def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                          cnt: jax.Array, row_node: jax.Array,
                          tbl: jax.Array, member: jax.Array,
                          feat_tbl: jax.Array, *, num_slots: int, bmax: int,
                          row_block: int = 4096, has_cat: bool = True,
                          double_prec: bool = True, quantized: bool = False,
+                         num_features: int = 0,
                          interpret: bool = False):
     """One sweep: route rows through the previous pass's packed split
     tables (pack_route_tables) AND build the per-slot histograms of the
@@ -564,12 +645,18 @@ def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     (slot -1), matching route_rows_mxu + build_histograms_mxu. Routing is
     idempotent: a second sweep through the same tables is the identity
     (children are not split in the table), which the grower uses to flush
-    the final pass's routing after its loops."""
-    n, f = bins.shape
+    the final pass's routing after its loops.
+
+    num_features > 0 marks `bins` as 4-bit packed (pack_bins_4bit) with
+    that many logical features; nibbles unpack in VMEM."""
+    n, fcols = bins.shape
+    f = num_features if num_features else fcols
+    fh = fcols if num_features else 0
     nb = row_block
     s = num_slots
     b = ((bmax + 127) // 128) * 128
-    flane = ((f + 127) // 128) * 128
+    plane = ((fcols + 127) // 128) * 128     # bins block width (packed)
+    flane = ((f + 127) // 128) * 128         # feature-table width
     m, kcols = tbl.shape
     bpad = member.shape[1]
 
@@ -577,8 +664,8 @@ def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     if npad:
         bins = jnp.pad(bins, ((0, npad), (0, 0)))
         row_node = jnp.pad(row_node, (0, npad))
-    if flane != f:
-        bins = jnp.pad(bins, ((0, 0), (0, flane - f)))
+    if plane != fcols:
+        bins = jnp.pad(bins, ((0, 0), (0, plane - fcols)))
     if feat_tbl.shape[0] != flane:
         feat_tbl = jnp.pad(feat_tbl,
                            ((0, flane - feat_tbl.shape[0]), (0, 0)))
@@ -589,11 +676,11 @@ def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     nblocks = (n + npad) // nb
     hist, node_out = pl.pallas_call(
         _fused_kernel(nb, f, flane, b, s, m, bpad, nchan=nchan,
-                      has_cat=has_cat),
+                      has_cat=has_cat, fh=fh),
         grid=(nblocks,),
         in_specs=[
             pl.BlockSpec((nb, 1), lambda ri: (ri, 0)),
-            pl.BlockSpec((nb, flane), lambda ri: (ri, 0)),
+            pl.BlockSpec((nb, plane), lambda ri: (ri, 0)),
             pl.BlockSpec((nb, 8), lambda ri: (ri, 0)),
             pl.BlockSpec((m, kcols), lambda ri: (0, 0)),
             pl.BlockSpec((m, bpad), lambda ri: (0, 0)),
@@ -678,7 +765,7 @@ def pack_route_tables(split_mask, feat, thr, default_left, is_cat,
 
 
 def _route_kernel(nb: int, f: int, m: int, bpad: int,
-                  has_cat: bool = True):
+                  has_cat: bool = True, fh: int = 0):
     # every per-row quantity is kept [nb, 1] (2-D) — Mosaic lowers 2-D
     # masks/selects cleanly where 1-D bool vectors hit unsupported i1 casts
     def kernel(node_ref, bins_ref, tbl_ref, member_ref, feat_tbl_ref,
@@ -719,7 +806,7 @@ def _route_kernel(nb: int, f: int, m: int, bpad: int,
             new_node_f = _route_decide(
                 node, gath, bins_ref[:].astype(jnp.int32)
                 .astype(jnp.float32), feat_tbl_ref[:], memb,
-                nb=nb, lanes=f)
+                nb=nb, fh=fh)
             out_ref[:] = jnp.concatenate(
                 [new_node_f, slot_of(new_node_f)],
                 axis=1).astype(jnp.int32)
@@ -728,16 +815,20 @@ def _route_kernel(nb: int, f: int, m: int, bpad: int,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("row_block", "interpret"))
+    jax.jit, static_argnames=("row_block", "num_features", "interpret"))
 def route_rows_mxu(bins: jax.Array, row_node: jax.Array, tbl: jax.Array,
                    member: jax.Array, feat_tbl: jax.Array, *,
-                   row_block: int = 1024, interpret: bool = False):
+                   row_block: int = 1024, num_features: int = 0,
+                   interpret: bool = False):
     """Advance rows one level and emit (new row_node, new row_slot).
 
     tbl/member: from pack_route_tables (M_pad lane-friendly).
     feat_tbl: [F, 2] f32: (num_bins, missing_is_nan).
+    num_features > 0 marks `bins` as 4-bit packed (pack_bins_4bit).
     """
-    n, f = bins.shape
+    n, fcols = bins.shape
+    f = num_features if num_features else fcols
+    fh = fcols if num_features else 0
     nb = row_block
     m, kcols = tbl.shape
     bpad = member.shape[1]
@@ -747,11 +838,11 @@ def route_rows_mxu(bins: jax.Array, row_node: jax.Array, tbl: jax.Array,
         row_node = jnp.pad(row_node, (0, npad))
     nblocks = (n + npad) // nb
     out = pl.pallas_call(
-        _route_kernel(nb, f, m, bpad),
+        _route_kernel(nb, f, m, bpad, fh=fh),
         grid=(nblocks,),
         in_specs=[
             pl.BlockSpec((nb, 1), lambda ri: (ri, 0)),
-            pl.BlockSpec((nb, f), lambda ri: (ri, 0)),
+            pl.BlockSpec((nb, fcols), lambda ri: (ri, 0)),
             pl.BlockSpec((m, kcols), lambda ri: (0, 0)),
             pl.BlockSpec((m, bpad), lambda ri: (0, 0)),
             pl.BlockSpec((f, 2), lambda ri: (0, 0)),

@@ -1,0 +1,76 @@
+"""Fused multi-tree training (boosting/fused.py, Booster.update_batch).
+
+update_batch(k) must be semantically identical to k update() calls:
+- ineligible configs (CPU scatter path here) fall back to a plain loop;
+- the fused scan itself must give bit-identical results for one scan of
+  k trees vs k scans of 1 tree (scan mechanics, stacking, iteration
+  indexing, score carry).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=600, f=5, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+PARAMS = {"objective": "binary", "num_leaves": 7, "learning_rate": 0.2,
+          "max_bin": 31, "verbosity": -1, "min_data_in_leaf": 5}
+
+
+def _booster(X, y):
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 31})
+    return lgb.Booster(params=dict(PARAMS), train_set=ds)
+
+
+class TestFallbackLoop:
+    def test_update_batch_equals_update_loop(self):
+        X, y = _data()
+        a = _booster(X, y)
+        b = _booster(X, y)
+        for _ in range(5):
+            a.update()
+        b.update_batch(5)
+        assert a.current_iteration() == b.current_iteration() == 5
+        np.testing.assert_array_equal(
+            np.asarray(a.gbdt.train_score), np.asarray(b.gbdt.train_score))
+        assert a.model_to_string() == b.model_to_string()
+
+
+@pytest.mark.slow
+class TestFusedScan:
+    def _mxu_booster(self, X, y):
+        bst = _booster(X, y)
+        bst.update()  # iteration 0 runs the normal (scatter) path
+        g = bst.gbdt
+        g._hist_impl = "mxu"  # force the fused-eligible path on CPU
+        g._mxu_interpret = True  # Pallas interpret mode (no TPU here)
+        g._fused_run = None
+        return bst
+
+    def test_scan_of_k_equals_k_scans(self):
+        X, y = _data(seed=3)
+        a = self._mxu_booster(X, y)
+        b = self._mxu_booster(X, y)
+        a.update_batch(3)
+        for _ in range(3):
+            b.update_batch(1)
+        assert a.current_iteration() == b.current_iteration() == 4
+        np.testing.assert_array_equal(
+            np.asarray(a.gbdt.train_score), np.asarray(b.gbdt.train_score))
+        for ta, tb in zip(a.gbdt.trees[1:], b.gbdt.trees[1:]):
+            for fld in ("split_feature", "threshold_bin", "left", "right"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(ta, fld)),
+                    np.asarray(getattr(tb, fld)), err_msg=fld)
+            np.testing.assert_array_equal(np.asarray(ta.leaf_value),
+                                          np.asarray(tb.leaf_value))
+        assert a.model_to_string() == b.model_to_string()

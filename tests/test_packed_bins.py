@@ -1,0 +1,157 @@
+"""4-bit packed bin storage (reference 4-bit DenseBin, dense_bin.hpp:42).
+
+Packing is a pure storage transform: the MXU kernels unpack nibbles in
+VMEM, so packed and unpacked training must produce bit-identical trees.
+Fast layout tests run in the default tier; kernel-parity tests ride the
+slow tier (Pallas interpret mode).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from lightgbm_tpu.data import BinnedDataset, Metadata
+from lightgbm_tpu.learner.grower_mxu import grow_tree_mxu
+from lightgbm_tpu.learner.histogram_mxu import (
+    build_histograms_mxu_v2, fused_route_hist_mxu, pack_bins_4bit,
+    pack_route_tables, route_rows_mxu, unpack_bins_4bit)
+from lightgbm_tpu.learner.split import SplitHyperParams
+
+
+def _small_bin_data(n=3000, f=7, seed=0, with_nan=False):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    if with_nan:
+        X[rng.rand(n) < 0.05, 1] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + 0.5 * np.nan_to_num(X[:, 1]) > 0) \
+        .astype(np.float32)
+    ds = BinnedDataset.from_raw(X, Metadata(n, label=y), max_bin=15)
+    assert int(ds.num_bins.max()) <= 16
+    p = np.full(n, 0.5, np.float32)
+    return ds, jnp.asarray(p - y), jnp.asarray(p * (1 - p))
+
+
+class TestPackLayout:
+    def test_roundtrip_even_odd(self):
+        rng = np.random.RandomState(3)
+        for f in (1, 2, 7, 8, 15):
+            bins = rng.randint(0, 16, size=(64, f)).astype(np.uint8)
+            packed = pack_bins_4bit(bins)
+            assert packed.shape == (64, (f + 1) // 2)
+            np.testing.assert_array_equal(
+                unpack_bins_4bit(packed, f), bins)
+
+    def test_roundtrip_device(self):
+        rng = np.random.RandomState(4)
+        bins = rng.randint(0, 16, size=(32, 5)).astype(np.uint8)
+        packed = pack_bins_4bit(jnp.asarray(bins))
+        np.testing.assert_array_equal(
+            np.asarray(unpack_bins_4bit(packed, 5)), bins)
+
+    def test_split_nibble_layout(self):
+        # feature j < Fh in column j's low nibble, Fh+j in the high one
+        bins = np.arange(8, dtype=np.uint8).reshape(1, 8) % 16
+        packed = pack_bins_4bit(bins)
+        fh = 4
+        for j in range(8):
+            col = j if j < fh else j - fh
+            nib = (packed[0, col] >> 4) if j >= fh else (packed[0, col] & 15)
+            assert nib == bins[0, j]
+
+
+@pytest.mark.slow
+class TestPackedKernels:
+    def test_hist_v2_parity(self):
+        ds, g, h = _small_bin_data()
+        bins = jnp.asarray(ds.bins)
+        cnt = jnp.ones(ds.num_data, jnp.float32)
+        slot = jnp.asarray(
+            np.random.RandomState(0).randint(-1, 8, size=ds.num_data)
+            .astype(np.int32))
+        bmax = int(ds.num_bins.max())
+        h_ref = build_histograms_mxu_v2(bins, g, h, cnt, slot, num_slots=8,
+                                        bmax=bmax, interpret=True)
+        h_pk = build_histograms_mxu_v2(
+            pack_bins_4bit(bins), g, h, cnt, slot, num_slots=8, bmax=bmax,
+            num_features=ds.num_features, interpret=True)
+        np.testing.assert_array_equal(np.asarray(h_ref), np.asarray(h_pk))
+
+    def _route_tables(self, ds, m_pad=128):
+        f = ds.num_features
+        m1 = 8
+        w_cat = (int(ds.num_bins.max()) + 31) // 32
+        split_mask = jnp.zeros(m1, bool).at[0].set(True)
+        feat = jnp.zeros(m1, jnp.int32).at[0].set(f - 1)  # high nibble
+        thr = jnp.zeros(m1, jnp.int32).at[0].set(7)
+        child_l = jnp.full(m1, m1 - 1, jnp.int32).at[0].set(1)
+        child_r = jnp.full(m1, m1 - 1, jnp.int32).at[0].set(2)
+        slot_of = jnp.full(m1, -1, jnp.int32).at[1].set(0).at[2].set(1)
+        return pack_route_tables(
+            split_mask, feat, thr, jnp.zeros(m1, bool),
+            jnp.zeros(m1, bool), child_l, child_r, slot_of,
+            jnp.zeros((m1, w_cat), jnp.uint32), m_pad,
+            int(ds.num_bins.max()))
+
+    def test_route_parity(self):
+        ds, _, _ = _small_bin_data(with_nan=True, seed=5)
+        bins = jnp.asarray(ds.bins)
+        tbl, member = self._route_tables(ds)
+        feat_tbl = jnp.stack(
+            [jnp.asarray(ds.num_bins, jnp.float32),
+             jnp.asarray(ds.missing_types == 2, jnp.float32)], axis=1)
+        node0 = jnp.zeros(ds.num_data, jnp.int32)
+        rn_ref, rs_ref = route_rows_mxu(bins, node0, tbl, member,
+                                        feat_tbl, interpret=True)
+        rn_pk, rs_pk = route_rows_mxu(
+            pack_bins_4bit(bins), node0, tbl, member, feat_tbl,
+            num_features=ds.num_features, interpret=True)
+        np.testing.assert_array_equal(np.asarray(rn_ref), np.asarray(rn_pk))
+        np.testing.assert_array_equal(np.asarray(rs_ref), np.asarray(rs_pk))
+
+    def test_fused_parity(self):
+        ds, g, h = _small_bin_data(with_nan=True, seed=6)
+        bins = jnp.asarray(ds.bins)
+        cnt = jnp.ones(ds.num_data, jnp.float32)
+        tbl, member = self._route_tables(ds)
+        feat_tbl = jnp.stack(
+            [jnp.asarray(ds.num_bins, jnp.float32),
+             jnp.asarray(ds.missing_types == 2, jnp.float32)], axis=1)
+        node0 = jnp.zeros(ds.num_data, jnp.int32)
+        bmax = int(ds.num_bins.max())
+        h_ref, rn_ref = fused_route_hist_mxu(
+            bins, g, h, cnt, node0, tbl, member, feat_tbl,
+            num_slots=4, bmax=bmax, interpret=True)
+        h_pk, rn_pk = fused_route_hist_mxu(
+            pack_bins_4bit(bins), g, h, cnt, node0, tbl, member, feat_tbl,
+            num_slots=4, bmax=bmax, num_features=ds.num_features,
+            interpret=True)
+        np.testing.assert_array_equal(np.asarray(h_ref), np.asarray(h_pk))
+        np.testing.assert_array_equal(np.asarray(rn_ref), np.asarray(rn_pk))
+
+    def test_grower_identical_trees(self):
+        ds, g, h = _small_bin_data(with_nan=True, seed=7)
+        cnt = jnp.ones(ds.num_data, jnp.float32)
+        args_tail = (cnt, jnp.ones(ds.num_features, jnp.float32),
+                     jnp.asarray(ds.num_bins),
+                     jnp.asarray(ds.missing_types == 2),
+                     jnp.asarray(ds.is_categorical))
+        kw = dict(num_leaves=15, max_depth=0,
+                  hp=SplitHyperParams(min_data_in_leaf=20),
+                  bmax=int(ds.num_bins.max()), interpret=True,
+                  overshoot=2.0)
+        t_ref, r_ref = grow_tree_mxu(jnp.asarray(ds.bins), g, h,
+                                     *args_tail, **kw)
+        t_pk, r_pk = grow_tree_mxu(pack_bins_4bit(jnp.asarray(ds.bins)),
+                                   g, h, *args_tail, packed4=True, **kw)
+        nn = int(t_ref.num_nodes)
+        assert int(t_ref.num_leaves) == int(t_pk.num_leaves)
+        for fld in ("split_feature", "threshold_bin", "left", "right",
+                    "default_left"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(t_ref, fld))[:nn],
+                np.asarray(getattr(t_pk, fld))[:nn], err_msg=fld)
+        np.testing.assert_array_equal(
+            np.asarray(t_ref.leaf_value)[:nn],
+            np.asarray(t_pk.leaf_value)[:nn])
+        np.testing.assert_array_equal(np.asarray(r_ref), np.asarray(r_pk))
