@@ -67,13 +67,15 @@ class DART(GBDT):
         scaled = tree._replace(leaf_value=tree.leaf_value * factor)
         return scaled
 
-    def _apply_tree_to_scores(self, it: int, cls: int, factor: float) -> None:
+    def _apply_tree_to_scores(self, it: int, cls: int, factor: float,
+                              bins_u=None) -> None:
         k = self.num_tree_per_iteration
         idx = it * k + cls
         tree = self.trees[idx]
         lin = self._lin(idx)
-        vals = self._tree_values(tree, lin, self._train_bins_unpacked(),
-                                 self.raw,
+        if bins_u is None:
+            bins_u = self._train_bins_unpacked()
+        vals = self._tree_values(tree, lin, bins_u, self.raw,
                                  self._efb)[:self.num_data] * factor
         if k == 1:
             self.train_score = self.train_score + vals
@@ -88,9 +90,11 @@ class DART(GBDT):
                 self.valid_scores[i] = self.valid_scores[i].at[:, cls].add(vv)
 
     def _drop_trees(self) -> None:
+        # one device unpack per iteration, not per dropped tree
+        bins_u = self._train_bins_unpacked() if self.drop_indices else None
         for it in self.drop_indices:
             for cls in range(self.num_tree_per_iteration):
-                self._apply_tree_to_scores(it, cls, -1.0)
+                self._apply_tree_to_scores(it, cls, -1.0, bins_u)
         if not self.config.xgboost_dart_mode:
             self.shrinkage_rate = float(self.config.learning_rate)
         else:
@@ -109,6 +113,8 @@ class DART(GBDT):
         else:
             new_factor = 1.0 / (k_drop + 1.0)
             old_factor = k_drop / (k_drop + 1.0)
+        # one device unpack for the whole normalize step
+        bins_u = self._train_bins_unpacked() if new_factor != 1.0 else None
         # scale the new trees (trained this iter) by new_factor
         for cls in range(k):
             idx = len(self.trees) - k + cls
@@ -116,8 +122,7 @@ class DART(GBDT):
             lin = self._lin(idx)
             if new_factor != 1.0:
                 # remove over-counted part from scores
-                vals = self._tree_values(tree, lin,
-                                         self._train_bins_unpacked(),
+                vals = self._tree_values(tree, lin, bins_u,
                                          self.raw, self._efb) \
                     [:self.num_data] * (new_factor - 1.0)
                 cls_id = self.tree_class[idx]

@@ -29,12 +29,12 @@ __all__ = ["build_fused_train"]
 
 def build_fused_train(*, objective, bins, cnt_weight, feature_mask_fn,
                       num_bins, missing_is_nan, is_cat, grower_kwargs,
-                      shrinkage: float, extra_seed: int, needs_rng: bool,
-                      interpret: bool = False):
+                      shrinkage: float, extra_seed: int, needs_rng: bool):
     """Return run(score, it0, k) -> (score', stacked TreeArrays).
 
     `objective.get_gradients` must be pure jnp (all built-in objectives
-    are); `grower_kwargs` are the static grow_tree_mxu settings;
+    are); `grower_kwargs` are the static grow_tree_mxu settings
+    (GBDT._mxu_grow_kwargs — shared with the per-iteration path);
     `feature_mask_fn(it)` produces the per-iteration feature_fraction
     mask (traced iteration index).
     """
@@ -42,6 +42,7 @@ def build_fused_train(*, objective, bins, cnt_weight, feature_mask_fn,
     from ..learner.histogram_mxu import node_values_mxu
 
     shrink = jnp.float32(shrinkage)
+    interpret = bool(grower_kwargs.get("interpret", False))
 
     def body(score, it):
         grad, hess = objective.get_gradients(score)
@@ -50,8 +51,7 @@ def build_fused_train(*, objective, bins, cnt_weight, feature_mask_fn,
             if needs_rng else None
         tree, row_node = grow_tree_mxu(
             bins, grad, hess, cnt_weight, fmask, num_bins,
-            missing_is_nan, is_cat, rng_key=rng, interpret=interpret,
-            **grower_kwargs)
+            missing_is_nan, is_cat, rng_key=rng, **grower_kwargs)
         # device-side stand-in for the "no further splits" break: a tree
         # that made no split becomes all-zero and the scan carries on
         # (train_one_iter's ok-zeroing, gbdt.py)

@@ -452,6 +452,24 @@ class GBDT:
         return jnp.asarray(np.concatenate(
             [np.asarray(s.data) for s in shards]))
 
+    def _mxu_grow_kwargs(self):
+        """Static grow_tree_mxu settings — single source shared by the
+        per-iteration path (_grow) and the fused scan (_build_fused) so
+        the two cannot drift apart."""
+        cfg = self.config
+        return dict(
+            num_leaves=cfg.num_leaves, max_depth=cfg.max_depth,
+            hp=self.hp, bmax=self.bmax, monotone=self._monotone,
+            interaction_groups=self._interaction_groups,
+            feature_fraction_bynode=cfg.feature_fraction_bynode,
+            hist_double_prec=cfg.gpu_use_dp,
+            tail_split_cap=cfg.tail_split_cap,
+            hist_subtraction=cfg.hist_subtraction,
+            overshoot=cfg.growth_overshoot,
+            quantized_grad=cfg.use_quantized_grad,
+            packed4=self._packed4,
+            interpret=getattr(self, "_mxu_interpret", False))
+
     def _grow(self, g, h, cnt, feature_mask):
         """Dispatch serial vs sharded growth; returns (tree, row_node[:N])."""
         cfg = self.config
@@ -466,16 +484,7 @@ class GBDT:
             return grow_tree_mxu(
                 self.bins, g, h, cnt, feature_mask, self.num_bins_d,
                 self.missing_is_nan_d, self.is_cat_d,
-                num_leaves=cfg.num_leaves, max_depth=cfg.max_depth,
-                hp=self.hp, bmax=self.bmax, monotone=self._monotone,
-                interaction_groups=self._interaction_groups,
-                feature_fraction_bynode=cfg.feature_fraction_bynode,
-                rng_key=rng_key, hist_double_prec=cfg.gpu_use_dp,
-                tail_split_cap=cfg.tail_split_cap,
-                hist_subtraction=cfg.hist_subtraction,
-                overshoot=cfg.growth_overshoot,
-                quantized_grad=cfg.use_quantized_grad,
-                packed4=self._packed4)
+                rng_key=rng_key, **self._mxu_grow_kwargs())
         if self._grower is None:
             out = grow_tree(
                 self.bins, g, h, cnt, feature_mask, self.num_bins_d,
@@ -809,17 +818,6 @@ class GBDT:
     def _build_fused(self):
         from .fused import build_fused_train
         cfg = self.config
-        grower_kwargs = dict(
-            num_leaves=cfg.num_leaves, max_depth=cfg.max_depth,
-            hp=self.hp, bmax=self.bmax, monotone=self._monotone,
-            interaction_groups=self._interaction_groups,
-            feature_fraction_bynode=cfg.feature_fraction_bynode,
-            hist_double_prec=cfg.gpu_use_dp,
-            tail_split_cap=cfg.tail_split_cap,
-            hist_subtraction=cfg.hist_subtraction,
-            overshoot=cfg.growth_overshoot,
-            quantized_grad=cfg.use_quantized_grad,
-            packed4=self._packed4)
         needs_rng = (cfg.feature_fraction_bynode < 1.0 or cfg.extra_trees
                      or cfg.use_quantized_grad)
         return build_fused_train(
@@ -827,10 +825,9 @@ class GBDT:
             cnt_weight=jnp.ones(self.num_data, jnp.float32),
             feature_mask_fn=self._feature_mask_at,
             num_bins=self.num_bins_d, missing_is_nan=self.missing_is_nan_d,
-            is_cat=self.is_cat_d, grower_kwargs=grower_kwargs,
+            is_cat=self.is_cat_d, grower_kwargs=self._mxu_grow_kwargs(),
             shrinkage=self.shrinkage_rate, extra_seed=cfg.extra_seed,
-            needs_rng=needs_rng,
-            interpret=getattr(self, "_mxu_interpret", False))
+            needs_rng=needs_rng)
 
     def train_many(self, k: int) -> bool:
         """K boosting iterations with one device dispatch (and at most
@@ -847,10 +844,14 @@ class GBDT:
         if k <= 0:
             return False
         if not self._fused_eligible():
+            # complete the whole batch like the fused path does (extra
+            # iterations on a stalled model append harmless constant
+            # trees), so batch size and iteration count never depend on
+            # eligibility
+            stop = False
             for _ in range(k):
-                if self.train_one_iter():
-                    return True
-            return False
+                stop = self.train_one_iter() or stop
+            return stop
         if getattr(self, "_fused_run", None) is None:
             self._fused_run = self._build_fused()
         with global_timer.timeit("tree_train"):
@@ -958,7 +959,9 @@ class GBDT:
                 # per-row gathers are ~10M rows/s on remoted TPUs; the
                 # one-hot matmul lookup kernel is ~50x faster
                 from ..learner.histogram_mxu import node_values_mxu
-                vals = node_values_mxu(row_node, tree.leaf_value)
+                vals = node_values_mxu(
+                    row_node, tree.leaf_value,
+                    interpret=getattr(self, "_mxu_interpret", False))
             else:
                 vals = tree.leaf_value[row_node]
         else:

@@ -271,8 +271,11 @@ def _route_decide(node, gath, bins_blk, ftbl, memb, *, nb: int,
     nibble j // fh — byte values <= 255 stay f32-exact, the nibble is
     recovered arithmetically after the column pick);
     memb: [nb, Bpad] categorical left-set membership or None when the
-    table holds no categorical splits. Returns new node ids [nb, 1] f32
-    (rows of unsplit nodes keep their node)."""
+    table holds no categorical splits. Returns (new node ids, next-pass
+    kernel slot) as [nb, 1] f32 pairs — rows of unsplit nodes keep
+    their node and their own slot; routed rows take the chosen child's
+    slot, carried in the PARENT row (_COL_SLOTL/_COL_SLOTR) so no
+    second node-table lookup is needed."""
 
     def col(c):
         return gath[:, c:c + 1]                              # [nb, 1] f32
@@ -328,7 +331,13 @@ def _route_decide(node, gath, bins_blk, ftbl, memb, *, nb: int,
     else:
         gl_f = num_gl
     child_f = gl_f * child_l + (one - gl_f) * child_r
-    return split * child_f + (one - split) * node.astype(jnp.float32)
+    slot_own = col(_COL_SLOT_Q) * 256.0 + col(_COL_SLOT_R)
+    slot_l = col(_COL_SLOTL_Q) * 256.0 + col(_COL_SLOTL_R)
+    slot_r = col(_COL_SLOTR_Q) * 256.0 + col(_COL_SLOTR_R)
+    slot_child = gl_f * slot_l + (one - gl_f) * slot_r
+    new_node = split * child_f + (one - split) * node.astype(jnp.float32)
+    new_slot = split * slot_child + (one - split) * slot_own
+    return new_node, new_slot
 
 
 def _hist_kernel_v2(nb: int, f: int, b: int, s: int,
@@ -586,9 +595,15 @@ def _fused_kernel(nb: int, f: int, flane: int, b: int, s: int, m: int,
         split = col(_COL_SPLIT)
         block_has_split = jnp.sum(split) > 0.5
 
+        def own_slot():
+            return (gath[:, _COL_SLOT_Q:_COL_SLOT_Q + 1] * 256.0 +
+                    gath[:, _COL_SLOT_R:_COL_SLOT_R + 1])
+
         @pl.when(~block_has_split)
         def _():
-            node_out_ref[:] = node
+            node_out_ref[:] = jnp.concatenate(
+                [node.astype(jnp.float32), own_slot()],
+                axis=1).astype(jnp.int32)
 
         @pl.when(block_has_split)
         def _():
@@ -596,23 +611,18 @@ def _fused_kernel(nb: int, f: int, flane: int, b: int, s: int, m: int,
                 node_oh, member_ref[:].astype(jnp.bfloat16),
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32) if has_cat else None
-            new_node_f = _route_decide(
+            new_node_f, new_slot_f = _route_decide(
                 node, gath, bins_ref[:].astype(jnp.int32)
                 .astype(jnp.float32), feat_tbl_ref[:], memb,
                 nb=nb, fh=fh)
-            node_out_ref[:] = new_node_f.astype(jnp.int32)
+            node_out_ref[:] = jnp.concatenate(
+                [new_node_f, new_slot_f], axis=1).astype(jnp.int32)
 
         # ---- histogram accumulation for every block holding slotted
-        # rows. Slots come from the (just-written) new node: unsplit
-        # nodes carry slot -1 in the table except the initial root pass,
-        # so this also covers blocks the route skipped.
-        new_node = node_out_ref[:]                           # [nb, 1] i32
-        new_oh = (new_node == iota_m).astype(jnp.bfloat16)
-        qr = jax.lax.dot_general(
-            new_oh, tbl_bf[:, _COL_SLOT_Q:_COL_SLOT_R + 1],
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)              # [nb, 2]
-        slot = (qr[:, 0:1] * 256.0 + qr[:, 1:2]).astype(jnp.int32)
+        # rows. The slot rode along with the route (child slots live in
+        # the parent's table row; unsplit nodes carry their own slot,
+        # -1 outside the initial root pass) — no second node lookup.
+        slot = node_out_ref[:, 1:2]                          # [nb, 1] i32
         block_any_slot = jnp.max(slot) >= 0
 
         @pl.when(block_any_slot)
@@ -688,11 +698,11 @@ def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         ],
         out_specs=[
             pl.BlockSpec((1, nchan * s, f * b), lambda ri: (0, 0, 0)),
-            pl.BlockSpec((nb, 1), lambda ri: (ri, 0)),
+            pl.BlockSpec((nb, 2), lambda ri: (ri, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((1, nchan * s, f * b), jnp.float32),
-            jax.ShapeDtypeStruct((n + npad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((n + npad, 2), jnp.int32),
         ],
         interpret=interpret,
         **({} if interpret else {"compiler_params": _COMPILER_PARAMS}),
@@ -724,7 +734,11 @@ _COL_RIGHT_R = 8   # right child id % 256
 _COL_SLOT_Q = 9    # next-pass slot // 256 (-1 encodes as (-1, 255))
 _COL_SLOT_R = 10   # next-pass slot % 256
 _COL_FEAT_Q = 11   # split feature // 256 (wide datasets)
-_N_COLS = 12
+_COL_SLOTL_Q = 12  # left child's next-pass slot // 256 (-1 = (-1, 255))
+_COL_SLOTL_R = 13  # left child's next-pass slot % 256
+_COL_SLOTR_Q = 14  # right child's next-pass slot // 256
+_COL_SLOTR_R = 15  # right child's next-pass slot % 256
+_N_COLS = 16
 
 
 def pack_route_tables(split_mask, feat, thr, default_left, is_cat,
@@ -749,6 +763,14 @@ def pack_route_tables(split_mask, feat, thr, default_left, is_cat,
     cr_q, cr_r = qr(child_r)
     sl_q, sl_r = qr(slot_of_node)
     f_q, f_r = qr(feat)
+    # children's kernel slots carried in the PARENT row so routing picks
+    # the destination slot without a second node-table lookup
+    cl_i = jnp.clip(child_l.astype(jnp.int32), 0, m1 - 1)
+    cr_i = jnp.clip(child_r.astype(jnp.int32), 0, m1 - 1)
+    slot_l = jnp.where(split_mask, slot_of_node[cl_i], -1)
+    slot_r = jnp.where(split_mask, slot_of_node[cr_i], -1)
+    slq_q, slq_r = qr(slot_l)
+    srq_q, srq_r = qr(slot_r)
     tbl = jnp.concatenate([
         split_mask.astype(jnp.float32)[:, None],
         f_r,
@@ -757,7 +779,8 @@ def pack_route_tables(split_mask, feat, thr, default_left, is_cat,
         is_cat.astype(jnp.float32)[:, None],
         cl_q, cl_r, cr_q, cr_r,
         sl_q, sl_r,
-        f_q], axis=1)
+        f_q,
+        slq_q, slq_r, srq_q, srq_r], axis=1)
     if m_pad > m1:
         tbl = jnp.pad(tbl, ((0, m_pad - m1), (0, 0)))
         member = jnp.pad(member, ((0, m_pad - m1), (0, 0)))
@@ -779,23 +802,19 @@ def _route_kernel(nb: int, f: int, m: int, bpad: int,
             node_oh, tbl_bf, dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)              # [nb, K]
 
-        def slot_of(node_f):
-            oh = (node_f.astype(jnp.int32) == iota_m).astype(jnp.bfloat16)
-            qr = jax.lax.dot_general(
-                oh, tbl_bf[:, _COL_SLOT_Q:_COL_SLOT_R + 1],
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)          # [nb, 2]
-            return qr[:, 0:1] * 256.0 + qr[:, 1:2]
-
         # blocks whose rows all sit in unsplit nodes (the common case in
         # late, narrow growth passes) skip the decision math entirely
         block_has_split = jnp.sum(gath[:, _COL_SPLIT:_COL_SPLIT + 1]) > 0.5
+
+        def own_slot():
+            return (gath[:, _COL_SLOT_Q:_COL_SLOT_Q + 1] * 256.0 +
+                    gath[:, _COL_SLOT_R:_COL_SLOT_R + 1])
 
         @pl.when(~block_has_split)
         def _():
             node_f = node.astype(jnp.float32)
             out_ref[:] = jnp.concatenate(
-                [node_f, slot_of(node_f)], axis=1).astype(jnp.int32)
+                [node_f, own_slot()], axis=1).astype(jnp.int32)
 
         @pl.when(block_has_split)
         def _():
@@ -803,13 +822,12 @@ def _route_kernel(nb: int, f: int, m: int, bpad: int,
                 node_oh, member_ref[:].astype(jnp.bfloat16),
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32) if has_cat else None
-            new_node_f = _route_decide(
+            new_node_f, new_slot_f = _route_decide(
                 node, gath, bins_ref[:].astype(jnp.int32)
                 .astype(jnp.float32), feat_tbl_ref[:], memb,
                 nb=nb, fh=fh)
             out_ref[:] = jnp.concatenate(
-                [new_node_f, slot_of(new_node_f)],
-                axis=1).astype(jnp.int32)
+                [new_node_f, new_slot_f], axis=1).astype(jnp.int32)
 
     return kernel
 

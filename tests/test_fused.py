@@ -56,6 +56,20 @@ class TestFusedScan:
         g._fused_run = None
         return bst
 
+    def test_fused_equals_per_iteration_mxu(self):
+        # the core contract: the fused scan must grow the SAME trees as
+        # k train_one_iter calls through the per-iteration MXU path
+        X, y = _data(seed=4)
+        a = self._mxu_booster(X, y)
+        b = self._mxu_booster(X, y)
+        a.update_batch(3)
+        for _ in range(3):
+            b.update()
+        assert a.current_iteration() == b.current_iteration() == 4
+        np.testing.assert_array_equal(
+            np.asarray(a.gbdt.train_score), np.asarray(b.gbdt.train_score))
+        assert a.model_to_string() == b.model_to_string()
+
     def test_scan_of_k_equals_k_scans(self):
         X, y = _data(seed=3)
         a = self._mxu_booster(X, y)
