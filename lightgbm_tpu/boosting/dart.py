@@ -113,8 +113,11 @@ class DART(GBDT):
         else:
             new_factor = 1.0 / (k_drop + 1.0)
             old_factor = k_drop / (k_drop + 1.0)
-        # one device unpack for the whole normalize step
-        bins_u = self._train_bins_unpacked() if new_factor != 1.0 else None
+        # one device unpack for the whole normalize step (new-tree
+        # rescale AND the dropped-tree old_factor loop below)
+        bins_u = self._train_bins_unpacked() \
+            if (new_factor != 1.0 or
+                (self.drop_indices and old_factor != 1.0)) else None
         # scale the new trees (trained this iter) by new_factor
         for cls in range(k):
             idx = len(self.trees) - k + cls
@@ -150,7 +153,7 @@ class DART(GBDT):
         # scale dropped trees back in with old_factor
         for it in self.drop_indices:
             for cls in range(k):
-                self._apply_tree_to_scores(it, cls, old_factor)
+                self._apply_tree_to_scores(it, cls, old_factor, bins_u)
                 idx = it * k + cls
                 self.trees[idx] = self.trees[idx]._replace(
                     leaf_value=self.trees[idx].leaf_value * old_factor)

@@ -351,7 +351,8 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             h = h * hist_scale  # integer sums -> gradient units
         return _allred(h), rn
 
-    def one_pass(s, st, pass_idx, k_cap=None, sk_next=None, m_cap=None):
+    def one_pass(s, st, pass_idx, k_cap=None, sk_next=None, m_cap=None,
+                 sk_self=None):
         """One growth pass at scan capacity `s` (python int). sk_next is
         the kernel-slot capacity of the NEXT pass (selection is throttled
         so committed splits' children fit it)."""
@@ -366,7 +367,7 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         if hist_subtraction:
             # build only the slots assigned by the previous pass (smaller
             # siblings + both children of stale parents) ...
-            sk = _kernel_cap(s)
+            sk = sk_self if sk_self is not None else _kernel_cap(s)
             kern, row_node = sweep(row_node, tbl_c, member_c, sk,
                                    m_cap=m_cap)
             # ... and reconstruct the full scan tensor [s, F, B, 3] with
@@ -686,12 +687,19 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # 2.09 -> 1.70 trees/s over 95 trees).
     if over:
         s_fix = min(128, s_max)
+        # overshoot fixups are dominated by throttled STALE pairs
+        # (2 kernel slots each); the frontier-sized kernel lets a pass
+        # commit s_fix/2 of them instead of ~s_fix/4, halving the number
+        # of full-row sweeps on exactly the late-boosting trees that
+        # decay
+        sk_fix = s_fix if hist_subtraction else None
     elif tail_split_cap <= 0:
         s_fix = min(64, s_max)
+        sk_fix = _kernel_cap(s_fix) if hist_subtraction else None
     else:
         s_fix = min(s_max, max(16, 2 * tail_split_cap))
+        sk_fix = _kernel_cap(s_fix) if hist_subtraction else None
     k_fix = max(1, s_fix // 2)
-    sk_fix = _kernel_cap(s_fix) if hist_subtraction else None
     if schedule:
         state = cond_pass(s_max, state, len(schedule), k_cap=k_fix,
                           sk_next=sk_fix)
@@ -703,7 +711,7 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     def body(c):
         st, it = c
         return one_pass(s_fix, st, it + 1000, k_cap=k_fix,
-                        sk_next=sk_fix), it + 1
+                        sk_next=sk_fix, sk_self=sk_fix), it + 1
 
     state, it_final = jax.lax.while_loop(
         cond, body, (state, jnp.asarray(len(schedule) + 1, jnp.int32)))
