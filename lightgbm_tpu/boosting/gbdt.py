@@ -86,16 +86,24 @@ class GBDT:
             nproc_now = jax.process_count()
         except RuntimeError:
             nproc_now = 1
-        if nproc_now > 1 and cfg.enable_bundle:
-            # the greedy plan is derived from LOCAL rows; ranks would
-            # disagree on bundle membership/width and the SPMD programs
-            # would diverge. A synced plan (sample-then-allgather like
-            # the bin mappers) is future work.
-            Log.info("EFB disabled under multi-machine training "
-                     "(bundle plans are not yet synchronized)")
-        elif cfg.enable_bundle and not cfg.linear_tree and ds.num_features:
+        if cfg.enable_bundle and not cfg.linear_tree and ds.num_features:
             from ..efb import build_plan, bundle_matrix, make_device_tables
-            plan = build_plan(np.asarray(ds.bins), ds.num_bins,
+            plan_bins = np.asarray(ds.bins)
+            if nproc_now > 1:
+                # the greedy plan must be IDENTICAL on every rank or the
+                # SPMD programs diverge. Same recipe as distributed bin-
+                # mapper construction (dataset_loader.cpp:722-807):
+                # deterministic fixed-size local row sample -> allgather
+                # -> every rank plans over the identical pooled sample.
+                from jax.experimental import multihost_utils
+                k_samp = max(1, 20000 // nproc_now)
+                rs = np.random.RandomState(13)
+                n_loc = plan_bins.shape[0]
+                idx = rs.choice(n_loc, k_samp, replace=n_loc < k_samp)
+                pooled = np.asarray(multihost_utils.process_allgather(
+                    np.ascontiguousarray(plan_bins[np.sort(idx)])))
+                plan_bins = pooled.reshape(-1, plan_bins.shape[1])
+            plan = build_plan(plan_bins, ds.num_bins,
                               ds.default_bins,
                               np.asarray(ds.is_categorical),
                               max_bundle_bins=256)
@@ -175,10 +183,17 @@ class GBDT:
         backend = jax.default_backend()
         if cfg.use_pallas and self._grower is None and backend != "cpu":
             # the mxu kernels carry bin values through bf16 matmul
-            # operands, exact only for max_bin <= 256
+            # operands, exact only for max_bin <= 256. EFB rides the mxu
+            # path too (bundle-space histograms + per-pass expansion)
+            # when the bundle bins fit bf16 exactness and the expanded
+            # scan tensor fits a device-memory budget.
+            efb_mxu_ok = self._efb is None or (
+                cfg.efb_use_mxu and
+                self._efb.bundle_bmax <= 256 and
+                self._mxu_expand_bytes(cfg) <= 1 << 30)
             if self._forced is None and self._cegb_cfg is None and \
                     self.bmax <= 256 and not self._mono_nonbasic and \
-                    self._efb is None:
+                    efb_mxu_ok:
                 self._hist_impl = "mxu"
             else:
                 self._hist_impl = "pallas" if self._efb is None \
@@ -197,7 +212,8 @@ class GBDT:
         # two-features-per-byte; the MXU kernels unpack in VMEM. Exact.
         self._packed4 = False
         if (self._hist_impl == "mxu" and cfg.bin_pack_4bit and
-                self.bmax <= 16 and not cfg.linear_tree):
+                self.bmax <= 16 and not cfg.linear_tree and
+                self._efb is None):
             from ..learner.histogram_mxu import (fits_v2, pack_bins_4bit)
             # packing only pays when every growth pass stays on the
             # fused/v2 kernels (VMEM-resident histograms); the v1
@@ -452,12 +468,22 @@ class GBDT:
         return jnp.asarray(np.concatenate(
             [np.asarray(s.data) for s in shards]))
 
+    def _mxu_expand_bytes(self, cfg) -> int:
+        """Per-pass expanded scan tensor size under EFB on the MXU path
+        ([s_max, F, bmax, 3] f32)."""
+        import math as _math
+        over = cfg.growth_overshoot if cfg.growth_overshoot >= 1.0 else 1.0
+        s_max = int(_math.ceil(cfg.num_leaves * over)) + 1
+        f = int(self.num_bins_d.shape[0])
+        return s_max * f * self.bmax * 3 * 4
+
     def _mxu_grow_kwargs(self):
         """Static grow_tree_mxu settings — single source shared by the
         per-iteration path (_grow) and the fused scan (_build_fused) so
         the two cannot drift apart."""
         cfg = self.config
         return dict(
+            efb=self._efb,
             num_leaves=cfg.num_leaves, max_depth=cfg.max_depth,
             hp=self.hp, bmax=self.bmax, monotone=self._monotone,
             interaction_groups=self._interaction_groups,

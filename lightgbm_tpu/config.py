@@ -257,6 +257,13 @@ _PARAMS: List[ParamSpec] = [
        "many splits per pass before re-ranking (approaches the "
        "reference's strict best-first order, serial_tree_learner.cpp:159, "
        "as the cap shrinks). 0 = unthrottled batched growth"),
+    _p("efb_use_mxu", bool, False, (),
+       desc="route EFB-bundled training through the MXU growth path "
+            "(bundle-space histogram kernels + per-pass expansion to "
+            "original features). Parity-tested but measured SLOWER than "
+            "the portable grower at 200k x 1000 x 63-bin shapes (the "
+            "expansion dominates at wide F); kept opt-in until the "
+            "segmented bundle-space split scan lands"),
     _p("bin_pack_4bit", bool, True, ("four_bit_bins",),
        desc="store the device bin matrix two-features-per-byte when "
             "every feature fits 4 bits (max_bin <= 15; the reference's "

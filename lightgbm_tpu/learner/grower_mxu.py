@@ -191,6 +191,7 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   quantized_grad: bool = False,
                   use_scan_kernel: bool = False,
                   packed4: bool = False,
+                  efb=None,
                   debug_info: bool = False
                   ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree; same contract as grower.grow_tree (serial mode).
@@ -216,10 +217,22 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     packed4=True marks `bins` as 4-bit packed storage (pack_bins_4bit,
     the reference's 4-bit DenseBin, src/io/dense_bin.hpp:42): the kernels
     unpack nibbles in VMEM, so HBM holds half the bin bytes. Exact —
-    identical trees to unpacked storage."""
+    identical trees to unpacked storage.
+
+    efb (EfbDev, efb.py) marks `bins` as the BUNDLED matrix [N, Fb]:
+    histograms build in bundle space ([S, Fb, Bb, 3] — the flop and
+    state win on wide-sparse data) and are expanded per pass back to
+    original features for the split scan; routing decodes original
+    local bins through efb.loc_table inside the kernels. Same math as
+    the portable grower's EFB path (grower.py), so trees match it."""
     n = bins.shape[0]
-    f = int(num_bins.shape[0]) if packed4 else bins.shape[1]
+    f = int(num_bins.shape[0]) if (packed4 or efb is not None) \
+        else bins.shape[1]
     nf_packed = f if packed4 else 0
+    # kernel-space dims: bundle columns/bins when EFB is active
+    fk = bins.shape[1] if efb is not None else f
+    bk = efb.bundle_bmax if efb is not None else bmax
+    loc_tbl = efb.loc_table if efb is not None else None
     # overshoot > 1 switches to overgrow-and-prune: grow toward
     # overshoot*num_leaves leaves with unthrottled batched passes, then
     # replay the exact best-first selection over the recorded gains
@@ -328,23 +341,30 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         if m_cap is not None and m_cap < m_pad:
             tbl_c = tbl_c[:m_cap]
             member_c = member_c[:m_cap]
-        if fits_v2(nslots, f, bmax, hist_double_prec, quant):
+        # measured on v5e: small frontiers run ~15% cheaper at half
+        # blocks, large ones prefer the wider block; the EFB route side
+        # (original-feature one-hots + loc decode) needs the small block
+        # to stay inside VMEM at wide F
+        rb = 1024 if efb is not None else \
+            (2048 if nslots <= 64 else 4096)
+        if fits_v2(nslots, fk, bk, hist_double_prec, quant,
+                   route_width=f if efb is not None else 0,
+                   row_block=rb):
             h, rn = fused_route_hist_mxu(
                 bins, h_grad, h_hess, cnt_weight, row_node, tbl_c,
-                member_c, feat_tbl, num_slots=nslots, bmax=bmax,
+                member_c, feat_tbl, num_slots=nslots, bmax=bk,
                 has_cat=hp.has_categorical, quantized=quant,
                 double_prec=hist_double_prec, num_features=nf_packed,
-                # measured on v5e: small frontiers run ~15% cheaper at
-                # half blocks, large ones prefer the wider block
-                row_block=2048 if nslots <= 64 else 4096,
+                loc_table=loc_tbl, row_block=rb,
                 interpret=interpret)
         else:
             rn, rs = route_rows_mxu(bins, row_node, tbl_c, member_c,
                                     feat_tbl, num_features=nf_packed,
+                                    loc_table=loc_tbl,
                                     interpret=interpret)
             h = build_histograms_mxu_auto(
                 bins, h_grad, h_hess, cnt_weight, rs, num_slots=nslots,
-                bmax=bmax, interpret=interpret, quantized=quant,
+                bmax=bk, interpret=interpret, quantized=quant,
                 double_prec=hist_double_prec, num_features=nf_packed,
                 **hist_cfg(nslots))
         if quant:
@@ -410,10 +430,19 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 precision=jax.lax.Precision.HIGHEST,
                 preferred_element_type=jnp.float32) \
-                .reshape(s, f, bmax, 3)
+                .reshape(s, fk, bk, 3)
         else:
             hist, row_node = sweep(row_node, tbl_c, member_c, s,
                                    m_cap=m_cap)
+        if efb is not None:
+            # subtraction/parent state live in bundle space (above);
+            # the split scan runs on original features — expand here
+            # (linear, so it commutes with the psum and the sibling
+            # subtraction; efb.expand_histograms)
+            from ..efb import expand_histograms
+            hist_scan = expand_histograms(hist, efb)
+        else:
+            hist_scan = hist
 
         slot_fmask = jnp.broadcast_to(feature_mask[None, :], (s, f))
         if use_bynode:
@@ -443,14 +472,16 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # where launch overhead dominates.
         if use_scan_kernel and kernel_supports(hp) and rand_bins is None:
             bs = find_best_splits_kernel(
-                hist, tree.sum_grad[sn], tree.sum_hess[sn], tree.count[sn],
+                hist_scan, tree.sum_grad[sn], tree.sum_hess[sn],
+                tree.count[sn],
                 tree.leaf_value[sn], num_bins, missing_is_nan, is_cat_feat,
                 slot_fmask, hp, monotone=monotone, cons_min=cons_min[sn],
                 cons_max=cons_max[sn], depth=tree.depth[sn],
                 interpret=interpret)
         else:
             bs = find_best_splits(
-                hist, tree.sum_grad[sn], tree.sum_hess[sn], tree.count[sn],
+                hist_scan, tree.sum_grad[sn], tree.sum_hess[sn],
+                tree.count[sn],
                 tree.leaf_value[sn], num_bins, missing_is_nan, is_cat_feat,
                 slot_fmask, hp, monotone=monotone, cons_min=cons_min[sn],
                 cons_max=cons_max[sn], depth=tree.depth[sn],
@@ -606,10 +637,12 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # rows through them (the final flush after the loops applies the
         # last pass's tables — routing is idempotent, see
         # fused_route_hist_mxu)
+        fclip = jnp.clip(feat, 0, f - 1)
         tbl_c, member_c = pack_route_tables(
-            split_mask, jnp.clip(feat, 0, f - 1), best.threshold_bin,
+            split_mask, fclip, best.threshold_bin,
             best.default_left, new_tree.is_cat, child_l, child_r,
-            slot_of_node, new_tree.cat_bitset, m_pad, bmax)
+            slot_of_node, new_tree.cat_bitset, m_pad, bmax,
+            bcol=efb.col_of_feat[fclip] if efb is not None else None)
 
         done = (k == 0) | (new_tree.num_leaves >= L_g)
         return (new_tree, row_node, tbl_c, member_c, slot_nodes, new_best,
@@ -637,7 +670,7 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
              path_mask0,
              jnp.asarray(False),
              jnp.zeros((P_all if hist_subtraction else 1,
-                        f * bmax * 3 if hist_subtraction else 1),
+                        fk * bk * 3 if hist_subtraction else 1),
                        jnp.float32),                       # parent_hist
              jnp.full(P_all, -1, jnp.int32),               # pair_parent
              jnp.full(P_all, True),                        # pair_sleft
@@ -722,7 +755,7 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # START of a pass, so the final commits have not moved rows yet)
     row_node, _ = route_rows_mxu(bins, state[1], state[2], state[3],
                                  feat_tbl, num_features=nf_packed,
-                                 interpret=interpret)
+                                 loc_table=loc_tbl, interpret=interpret)
     tree_out = state[0]
     cmin, cmax = state[6], state[7]
     if over:

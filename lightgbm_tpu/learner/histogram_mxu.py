@@ -263,13 +263,18 @@ def _hist_accumulate(hist_ref, slot, bins_i, data, *, nb: int, f: int,
 
 
 def _route_decide(node, gath, bins_blk, ftbl, memb, *, nb: int,
-                  fh: int = 0):
+                  fh: int = 0, loc=None):
     """Shared split-decision math of the route/fused kernels: numerical
     thresholds, NaN-bin default direction, categorical bitset membership.
     gath: [nb, K] node-table row per row; bins_blk: [nb, lanes] f32
     (fh > 0: 4-bit packed byte columns, feature j at column j % fh,
     nibble j // fh — byte values <= 255 stay f32-exact, the nibble is
     recovered arithmetically after the column pick);
+    loc is not None: bins_blk holds EFB bundle columns; the split
+    feature's bundle column (_COL_BCOL) is selected, then the original
+    local bin is decoded through the [F, Bb] loc_table (efb.py: default
+    bin folded in for out-of-segment positions) — the decision math
+    below then runs on original bins unchanged;
     memb: [nb, Bpad] categorical left-set membership or None when the
     table holds no categorical splits. Returns (new node ids, next-pass
     kernel slot) as [nb, 1] f32 pairs — rows of unsplit nodes keep
@@ -299,11 +304,28 @@ def _route_decide(node, gath, bins_blk, ftbl, memb, *, nb: int,
         hi_val = jnp.floor(pbyte * jnp.float32(1.0 / 16.0))
         binv = is_hi * hi_val + (1.0 - is_hi) * (pbyte - 16.0 * hi_val)
     # per-feature flags (num_bins, missing_is_nan) index the full-width
-    # feature table regardless of bin packing
+    # feature table regardless of bin packing/bundling
     iota_f = jax.lax.broadcasted_iota(
         jnp.int32, (nb, ftbl.shape[0]), 1).astype(jnp.float32)
     feat_oh = (pf == iota_f)                                 # [nb, L] bool
-    if not fh:
+    if loc is not None:
+        # EFB: bundle-column select, then original-local-bin decode
+        bcol = col(_COL_BCOL_Q) * 256.0 + col(_COL_BCOL_R)
+        iota_c = jax.lax.broadcasted_iota(
+            jnp.int32, (nb, bins_blk.shape[1]), 1).astype(jnp.float32)
+        pval = jnp.sum(jnp.where(bcol == iota_c, bins_blk, 0.0),
+                       axis=1, keepdims=True)                # [nb, 1] f32
+        # loc row of the split feature: one MXU dot (entries <= 256,
+        # bf16-exact; 0/1 lhs keeps the f32 accumulation a selection)
+        loc_row = jax.lax.dot_general(
+            feat_oh.astype(jnp.bfloat16), loc.astype(jnp.bfloat16),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [nb, Bb]
+        iota_b2 = jax.lax.broadcasted_iota(
+            jnp.int32, (nb, loc.shape[1]), 1).astype(jnp.float32)
+        binv = jnp.sum(jnp.where(pval == iota_b2, loc_row, 0.0),
+                       axis=1, keepdims=True)                # [nb, 1] f32
+    elif not fh:
         # column select: binv[r] = bins[r, pf[r]] via one-hot mask-sum
         binv = jnp.sum(jnp.where(feat_oh, bins_blk, 0.0), axis=1,
                        keepdims=True)                        # [nb, 1] f32
@@ -451,19 +473,40 @@ def build_histograms_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     return hist
 
 
-# VMEM budget for the v2 kernel's resident output block; beyond it the
-# chunked v1 kernel takes over (wide-feature datasets)
-_V2_OUT_BYTES = 48 * 1024 * 1024
+# VMEM budget for the v2/fused kernels: resident histogram output block
+# plus the per-row-block input working set (binned lanes in i32/f32 and
+# the bin one-hot scratch). Beyond it the chunked v1 kernel takes over
+# (wide-feature datasets) — without the input term, wide-F data at tiny
+# frontiers passed the output check and then failed scoped-VMEM
+# allocation inside the fused kernel (observed at F=1000, bmax=64).
+_V2_BUDGET_BYTES = 80 * 1024 * 1024
+_V2_ROW_BLOCK = 4096  # worst-case block the grower/dispatcher may pick
 
 
 def fits_v2(num_slots: int, num_features: int, bmax: int,
-            double_prec: bool = True, quantized: bool = False) -> bool:
-    """Whether the extraction-free v2/fused kernels' resident histogram
-    block fits the VMEM budget for this shape (single owner of the
-    predicate — the grower and the auto dispatcher must agree)."""
+            double_prec: bool = True, quantized: bool = False,
+            route_width: int = 0,
+            row_block: int = _V2_ROW_BLOCK) -> bool:
+    """Whether the extraction-free v2/fused kernels' working set fits
+    the VMEM budget for this shape (single owner of the predicate — the
+    grower and the auto dispatcher must agree). route_width: the
+    original-feature table width when it differs from the bins width
+    (EFB: bins hold bundle columns but routing gathers original-feature
+    one-hots + the loc_table decode); row_block: the block the caller
+    will actually use."""
     b = ((bmax + 127) // 128) * 128
     nchan = 3 if quantized else (5 if double_prec else 4)
-    return nchan * num_slots * num_features * b * 4 <= _V2_OUT_BYTES
+    out = nchan * num_slots * num_features * b * 4
+    plane = ((num_features + 127) // 128) * 128
+    flane_r = ((max(route_width, num_features) + 127) // 128) * 128
+    # bins block in i32 + f32 (~3 lane buffers) + the route decide's
+    # iota/one-hot/where mask chain over the route width (~6 f32
+    # temporaries, more under the EFB loc decode), plus the [nb, G*B]
+    # bin one-hot scratch
+    route_cost = 36 if route_width and route_width != num_features else 24
+    inputs = row_block * (12 * plane + route_cost * flane_r +
+                          2 * _FGROUP * b)
+    return out + inputs <= _V2_BUDGET_BYTES
 
 
 @functools.partial(
@@ -560,7 +603,8 @@ def build_histograms_mxu_auto(bins, grad, hess, cnt, row_slot, *,
 
 def _fused_kernel(nb: int, f: int, flane: int, b: int, s: int, m: int,
                   bpad: int, mm_dtype=jnp.bfloat16, nchan: int = 5,
-                  has_cat: bool = True, fh: int = 0):
+                  has_cat: bool = True, fh: int = 0,
+                  has_efb: bool = False):
     """Route + histogram in ONE sweep over the binned matrix: advance each
     row through the splits committed by the previous pass (the
     _route_kernel math) and immediately scatter-accumulate it into its new
@@ -570,7 +614,7 @@ def _fused_kernel(nb: int, f: int, flane: int, b: int, s: int, m: int,
     (their rows keep their node and contribute to no slot)."""
 
     def kernel(node_ref, bins_ref, data_ref, tbl_ref, member_ref,
-               feat_tbl_ref, hist_ref, node_out_ref):
+               feat_tbl_ref, loc_ref, hist_ref, node_out_ref):
         ri = pl.program_id(0)
 
         @pl.when(ri == 0)
@@ -614,7 +658,8 @@ def _fused_kernel(nb: int, f: int, flane: int, b: int, s: int, m: int,
             new_node_f, new_slot_f = _route_decide(
                 node, gath, bins_ref[:].astype(jnp.int32)
                 .astype(jnp.float32), feat_tbl_ref[:], memb,
-                nb=nb, fh=fh)
+                nb=nb, fh=fh,
+                loc=loc_ref[:] if has_efb else None)
             node_out_ref[:] = jnp.concatenate(
                 [new_node_f, new_slot_f], axis=1).astype(jnp.int32)
 
@@ -645,7 +690,7 @@ def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                          feat_tbl: jax.Array, *, num_slots: int, bmax: int,
                          row_block: int = 4096, has_cat: bool = True,
                          double_prec: bool = True, quantized: bool = False,
-                         num_features: int = 0,
+                         num_features: int = 0, loc_table=None,
                          interpret: bool = False):
     """One sweep: route rows through the previous pass's packed split
     tables (pack_route_tables) AND build the per-slot histograms of the
@@ -658,15 +703,23 @@ def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     the final pass's routing after its loops.
 
     num_features > 0 marks `bins` as 4-bit packed (pack_bins_4bit) with
-    that many logical features; nibbles unpack in VMEM."""
+    that many logical features; nibbles unpack in VMEM.
+
+    loc_table ([F_orig, Bb] i32/f32) marks `bins` as EFB bundle columns:
+    histograms build in bundle space (f = bundle columns, bmax = Bb) and
+    routing decodes the original local bin through loc_table (efb.py);
+    feat_tbl stays original-feature-indexed."""
     n, fcols = bins.shape
+    has_efb = loc_table is not None
     f = num_features if num_features else fcols
     fh = fcols if num_features else 0
     nb = row_block
     s = num_slots
     b = ((bmax + 127) // 128) * 128
     plane = ((fcols + 127) // 128) * 128     # bins block width (packed)
-    flane = ((f + 127) // 128) * 128         # feature-table width
+    # route tables are original-feature-indexed under EFB
+    f_route = loc_table.shape[0] if has_efb else f
+    flane = ((f_route + 127) // 128) * 128
     m, kcols = tbl.shape
     bpad = member.shape[1]
 
@@ -679,6 +732,13 @@ def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     if feat_tbl.shape[0] != flane:
         feat_tbl = jnp.pad(feat_tbl,
                            ((0, flane - feat_tbl.shape[0]), (0, 0)))
+    if has_efb:
+        bb_lane = ((loc_table.shape[1] + 127) // 128) * 128
+        loc = jnp.pad(loc_table.astype(jnp.float32),
+                      ((0, flane - loc_table.shape[0]),
+                       (0, bb_lane - loc_table.shape[1])))
+    else:
+        loc = jnp.zeros((8, 128), jnp.float32)  # unused placeholder
     data, nchan = _hist_channels(grad, hess, cnt, double_prec, quantized)
     if npad:
         data = jnp.pad(data, ((0, npad), (0, 0)))
@@ -686,7 +746,7 @@ def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     nblocks = (n + npad) // nb
     hist, node_out = pl.pallas_call(
         _fused_kernel(nb, f, flane, b, s, m, bpad, nchan=nchan,
-                      has_cat=has_cat, fh=fh),
+                      has_cat=has_cat, fh=fh, has_efb=has_efb),
         grid=(nblocks,),
         in_specs=[
             pl.BlockSpec((nb, 1), lambda ri: (ri, 0)),
@@ -695,6 +755,7 @@ def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             pl.BlockSpec((m, kcols), lambda ri: (0, 0)),
             pl.BlockSpec((m, bpad), lambda ri: (0, 0)),
             pl.BlockSpec((flane, 2), lambda ri: (0, 0)),
+            pl.BlockSpec(loc.shape, lambda ri: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, nchan * s, f * b), lambda ri: (0, 0, 0)),
@@ -707,7 +768,7 @@ def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         interpret=interpret,
         **({} if interpret else {"compiler_params": _COMPILER_PARAMS}),
     )(row_node.astype(jnp.int32)[:, None], bins, data, tbl, member,
-      feat_tbl)
+      feat_tbl, loc)
 
     h3 = _combine_hist(hist, nchan=nchan, s=s, f=f, b=b, bmax=bmax,
                        double_prec=double_prec)
@@ -738,14 +799,18 @@ _COL_SLOTL_Q = 12  # left child's next-pass slot // 256 (-1 = (-1, 255))
 _COL_SLOTL_R = 13  # left child's next-pass slot % 256
 _COL_SLOTR_Q = 14  # right child's next-pass slot // 256
 _COL_SLOTR_R = 15  # right child's next-pass slot % 256
-_N_COLS = 16
+_COL_BCOL_Q = 16   # split feature's EFB bundle column // 256
+_COL_BCOL_R = 17   # split feature's EFB bundle column % 256
+_N_COLS = 18
 
 
 def pack_route_tables(split_mask, feat, thr, default_left, is_cat,
                       child_l, child_r, slot_of_node, cat_bitset,
-                      m_pad: int, bmax: int):
-    """Node tables for route_rows_mxu: ([m_pad, 8] f32 scalars,
-    [m_pad, Bpad] 0/1 categorical left-set membership per bin)."""
+                      m_pad: int, bmax: int, bcol=None):
+    """Node tables for route_rows_mxu: ([m_pad, _N_COLS] f32 scalars,
+    [m_pad, Bpad] 0/1 categorical left-set membership per bin).
+    bcol: per-node EFB bundle column of the split feature (defaults to
+    the feature id itself — identity when bins are unbundled)."""
     m1 = split_mask.shape[0]
     w = cat_bitset.shape[1]
     bpad = ((bmax + 127) // 128) * 128
@@ -771,6 +836,7 @@ def pack_route_tables(split_mask, feat, thr, default_left, is_cat,
     slot_r = jnp.where(split_mask, slot_of_node[cr_i], -1)
     slq_q, slq_r = qr(slot_l)
     srq_q, srq_r = qr(slot_r)
+    bc_q, bc_r = qr(feat if bcol is None else bcol)
     tbl = jnp.concatenate([
         split_mask.astype(jnp.float32)[:, None],
         f_r,
@@ -780,7 +846,8 @@ def pack_route_tables(split_mask, feat, thr, default_left, is_cat,
         cl_q, cl_r, cr_q, cr_r,
         sl_q, sl_r,
         f_q,
-        slq_q, slq_r, srq_q, srq_r], axis=1)
+        slq_q, slq_r, srq_q, srq_r,
+        bc_q, bc_r], axis=1)
     if m_pad > m1:
         tbl = jnp.pad(tbl, ((0, m_pad - m1), (0, 0)))
         member = jnp.pad(member, ((0, m_pad - m1), (0, 0)))
@@ -788,11 +855,12 @@ def pack_route_tables(split_mask, feat, thr, default_left, is_cat,
 
 
 def _route_kernel(nb: int, f: int, m: int, bpad: int,
-                  has_cat: bool = True, fh: int = 0):
+                  has_cat: bool = True, fh: int = 0,
+                  has_efb: bool = False):
     # every per-row quantity is kept [nb, 1] (2-D) — Mosaic lowers 2-D
     # masks/selects cleanly where 1-D bool vectors hit unsupported i1 casts
     def kernel(node_ref, bins_ref, tbl_ref, member_ref, feat_tbl_ref,
-               out_ref):
+               loc_ref, out_ref):
         node = node_ref[:]                                   # [nb, 1] i32
         iota_m = jax.lax.broadcasted_iota(jnp.int32, (nb, m), 1)
         # bf16 operands are exact here: table entries <= 256 by design
@@ -825,7 +893,8 @@ def _route_kernel(nb: int, f: int, m: int, bpad: int,
             new_node_f, new_slot_f = _route_decide(
                 node, gath, bins_ref[:].astype(jnp.int32)
                 .astype(jnp.float32), feat_tbl_ref[:], memb,
-                nb=nb, fh=fh)
+                nb=nb, fh=fh,
+                loc=loc_ref[:] if has_efb else None)
             out_ref[:] = jnp.concatenate(
                 [new_node_f, new_slot_f], axis=1).astype(jnp.int32)
 
@@ -837,15 +906,18 @@ def _route_kernel(nb: int, f: int, m: int, bpad: int,
 def route_rows_mxu(bins: jax.Array, row_node: jax.Array, tbl: jax.Array,
                    member: jax.Array, feat_tbl: jax.Array, *,
                    row_block: int = 1024, num_features: int = 0,
-                   interpret: bool = False):
+                   loc_table=None, interpret: bool = False):
     """Advance rows one level and emit (new row_node, new row_slot).
 
     tbl/member: from pack_route_tables (M_pad lane-friendly).
     feat_tbl: [F, 2] f32: (num_bins, missing_is_nan).
     num_features > 0 marks `bins` as 4-bit packed (pack_bins_4bit).
+    loc_table marks `bins` as EFB bundle columns (fused_route_hist_mxu).
     """
     n, fcols = bins.shape
+    has_efb = loc_table is not None
     f = num_features if num_features else fcols
+    f_route = loc_table.shape[0] if has_efb else f
     fh = fcols if num_features else 0
     nb = row_block
     m, kcols = tbl.shape
@@ -854,22 +926,29 @@ def route_rows_mxu(bins: jax.Array, row_node: jax.Array, tbl: jax.Array,
     if npad:
         bins = jnp.pad(bins, ((0, npad), (0, 0)))
         row_node = jnp.pad(row_node, (0, npad))
+    if feat_tbl.shape[0] != f_route:
+        feat_tbl = jnp.pad(feat_tbl,
+                           ((0, f_route - feat_tbl.shape[0]), (0, 0)))
+    loc = loc_table.astype(jnp.float32) if has_efb else \
+        jnp.zeros((8, 128), jnp.float32)
     nblocks = (n + npad) // nb
     out = pl.pallas_call(
-        _route_kernel(nb, f, m, bpad, fh=fh),
+        _route_kernel(nb, f, m, bpad, fh=fh, has_efb=has_efb),
         grid=(nblocks,),
         in_specs=[
             pl.BlockSpec((nb, 1), lambda ri: (ri, 0)),
             pl.BlockSpec((nb, fcols), lambda ri: (ri, 0)),
             pl.BlockSpec((m, kcols), lambda ri: (0, 0)),
             pl.BlockSpec((m, bpad), lambda ri: (0, 0)),
-            pl.BlockSpec((f, 2), lambda ri: (0, 0)),
+            pl.BlockSpec((f_route, 2), lambda ri: (0, 0)),
+            pl.BlockSpec(loc.shape, lambda ri: (0, 0)),
         ],
         out_specs=pl.BlockSpec((nb, 2), lambda ri: (ri, 0)),
         out_shape=jax.ShapeDtypeStruct((n + npad, 2), jnp.int32),
         interpret=interpret,
         **({} if interpret else {"compiler_params": _COMPILER_PARAMS}),
-    )(row_node.astype(jnp.int32)[:, None], bins, tbl, member, feat_tbl)
+    )(row_node.astype(jnp.int32)[:, None], bins, tbl, member, feat_tbl,
+      loc)
     return out[:n, 0], out[:n, 1]
 
 

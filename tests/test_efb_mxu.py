@@ -1,0 +1,136 @@
+"""EFB on the MXU growth path (bundle-space kernels + device expansion).
+
+Equality target: the portable scatter grower's EFB path (grower.py),
+which is itself differentially tested against unbundled training in
+test_efb.py. Interpret mode, runs on CPU.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # Pallas interpret mode
+
+import jax.numpy as jnp
+
+from lightgbm_tpu.data import BinnedDataset, Metadata
+from lightgbm_tpu.efb import build_plan, bundle_matrix, make_device_tables
+from lightgbm_tpu.learner.grower import grow_tree
+from lightgbm_tpu.learner.grower_mxu import grow_tree_mxu
+from lightgbm_tpu.learner.split import SplitHyperParams
+
+
+def _sparse_ds(n=4000, f=24, seed=0, with_nan=False, with_cat=False):
+    rng = np.random.RandomState(seed)
+    X = np.zeros((n, f))
+    for g in range(0, f, 8):
+        which = rng.randint(g, g + 8, size=n)
+        X[np.arange(n), which] = rng.rand(n) + 0.5
+    if with_cat:
+        X[:, 3] = rng.randint(0, 6, size=n)  # dense categorical column
+    if with_nan:
+        X[rng.rand(n) < 0.05, 1] = np.nan
+    logit = np.nan_to_num(X[:, 0]) * 2 + X[:, 8] - X[:, 16] + \
+        0.3 * rng.randn(n)
+    y = (logit > np.median(logit)).astype(np.float32)
+    ds = BinnedDataset.from_raw(
+        X, Metadata(n, label=y), max_bin=15,
+        categorical_features=[3] if with_cat else None)
+    plan = build_plan(np.asarray(ds.bins), ds.num_bins, ds.default_bins,
+                      np.asarray(ds.is_categorical), max_bundle_bins=256)
+    assert plan is not None and plan.effective
+    efb = make_device_tables(plan, ds.default_bins)
+    bund = jnp.asarray(bundle_matrix(np.asarray(ds.bins), plan))
+    p = np.full(n, 0.5, np.float32)
+    return ds, efb, bund, jnp.asarray(p - y), jnp.asarray(p * (1 - p))
+
+
+def _grow_both(ds, efb, bund, g, h, num_leaves=15, **extra):
+    cnt = jnp.ones(ds.num_data, jnp.float32)
+    tail = (cnt, jnp.ones(ds.num_features, jnp.float32),
+            jnp.asarray(ds.num_bins), jnp.asarray(ds.missing_types == 2),
+            jnp.asarray(ds.is_categorical))
+    kw = dict(num_leaves=num_leaves, max_depth=0,
+              hp=SplitHyperParams(
+                  min_data_in_leaf=20,
+                  has_categorical=bool(np.any(ds.is_categorical))),
+              bmax=int(ds.num_bins.max()))
+    t_ref, r_ref = grow_tree(bund, g, h, *tail, leafwise=False,
+                             efb=efb, **kw)
+    t_mxu, r_mxu = grow_tree_mxu(bund, g, h, *tail, interpret=True,
+                                 efb=efb, **extra, **kw)
+    return t_ref, r_ref, t_mxu, r_mxu
+
+
+def _assert_same_tree(t_ref, r_ref, t_mxu, r_mxu):
+    assert int(t_ref.num_leaves) == int(t_mxu.num_leaves)
+    nn = int(t_ref.num_nodes)
+    for fld in ("split_feature", "threshold_bin", "left", "right",
+                "is_cat", "default_left"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(t_ref, fld))[:nn],
+            np.asarray(getattr(t_mxu, fld))[:nn], err_msg=fld)
+    np.testing.assert_allclose(np.asarray(t_ref.leaf_value)[:nn],
+                               np.asarray(t_mxu.leaf_value)[:nn],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(r_ref), np.asarray(r_mxu))
+
+
+class TestEfbMXU:
+    def test_matches_scatter_efb(self):
+        ds, efb, bund, g, h = _sparse_ds()
+        _assert_same_tree(*_grow_both(ds, efb, bund, g, h))
+
+    def test_matches_with_nan(self):
+        ds, efb, bund, g, h = _sparse_ds(seed=1, with_nan=True)
+        _assert_same_tree(*_grow_both(ds, efb, bund, g, h))
+
+    def test_matches_with_categorical(self):
+        ds, efb, bund, g, h = _sparse_ds(seed=2, with_cat=True)
+        _assert_same_tree(*_grow_both(ds, efb, bund, g, h))
+
+    def test_overgrow_prune_with_efb(self):
+        # mirror of test_mxu_kernels overshoot checks: the pruned tree
+        # must be self-consistent (row_node == routing fresh rows
+        # through it, via the bundle translation tables) and reach the
+        # leaf budget; exact structural parity vs batched growth is not
+        # expected (different growth order by design)
+        from lightgbm_tpu.learner.predict import predict_binned_tree
+        ds, efb, bund, g, h = _sparse_ds(seed=3)
+        cnt = jnp.ones(ds.num_data, jnp.float32)
+        tail = (cnt, jnp.ones(ds.num_features, jnp.float32),
+                jnp.asarray(ds.num_bins),
+                jnp.asarray(ds.missing_types == 2),
+                jnp.asarray(ds.is_categorical))
+        t, r = grow_tree_mxu(bund, g, h, *tail, num_leaves=15,
+                             max_depth=0,
+                             hp=SplitHyperParams(min_data_in_leaf=20),
+                             bmax=int(ds.num_bins.max()), interpret=True,
+                             overshoot=2.0, efb=efb)
+        assert int(t.num_leaves) == 15
+        vals_route = predict_binned_tree(
+            t, bund, jnp.asarray(ds.num_bins),
+            jnp.asarray(ds.missing_types == 2), efb)
+        vals_rows = np.asarray(t.leaf_value)[np.asarray(r)]
+        np.testing.assert_allclose(np.asarray(vals_route), vals_rows,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_quantized_with_efb(self):
+        ds, efb, bund, g, h = _sparse_ds(seed=4)
+        cnt = jnp.ones(ds.num_data, jnp.float32)
+        tail = (cnt, jnp.ones(ds.num_features, jnp.float32),
+                jnp.asarray(ds.num_bins),
+                jnp.asarray(ds.missing_types == 2),
+                jnp.asarray(ds.is_categorical))
+        kw = dict(num_leaves=15, max_depth=0,
+                  hp=SplitHyperParams(min_data_in_leaf=20),
+                  bmax=int(ds.num_bins.max()), interpret=True, efb=efb)
+        import jax
+        t, r = grow_tree_mxu(bund, g, h, *tail, quantized_grad=True,
+                             rng_key=jax.random.PRNGKey(0),
+                             overshoot=2.0, **kw)
+        # quantization perturbs only the search; leaf values are refit
+        # exactly — check the tree is sane and refit sums add up
+        assert int(t.num_leaves) >= 4
+        lf = np.asarray(t.is_leaf)
+        np.testing.assert_allclose(
+            np.asarray(t.count)[lf].sum(), ds.num_data, rtol=1e-6)

@@ -281,3 +281,112 @@ def test_two_process_sequence_input_matches_array_input(tmp_path):
             ln for ln in outs[0].read_text().splitlines()
             if "local_listen_port" not in ln and "machines" not in ln)
     assert models["array"] == models["seq"]
+
+
+_WORKER_EFB = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, {repo!r})
+    rank = int(os.environ["LIGHTGBM_TPU_MACHINE_RANK"])
+    ports = os.environ["TEST_PORTS"].split(",")
+    import lightgbm_tpu as lgb
+    lgb.setup_multihost(
+        2, ",".join(f"127.0.0.1:{{p}}" for p in ports),
+        local_listen_port=int(ports[rank]))
+    from conftest_data import make_sparse_data
+    X, y = make_sparse_data()
+    cut = len(y) // 2
+    sl = slice(0, cut) if rank == 0 else slice(cut, None)
+    params = dict(objective="binary", tree_learner="data",
+                  num_machines=2,
+                  machines=",".join(f"127.0.0.1:{{p}}" for p in ports),
+                  local_listen_port=int(ports[rank]),
+                  num_leaves=15, verbosity=-1, min_data_in_leaf=20,
+                  max_bin=15,  # small bins so 8 features fit one bundle
+                  boost_from_average=False)
+    bst = lgb.train(params, lgb.Dataset(X[sl], label=y[sl],
+                                        params={{"max_bin": 15}}), 5)
+    assert bst.gbdt._efb is not None, "EFB did not engage multi-machine"
+    bst.save_model(os.environ["TEST_OUT"])
+""")
+
+_SPARSE_DATA = textwrap.dedent("""
+    import numpy as np
+    def make_sparse_data(n=4096, f=24, seed=9):
+        # mutually-exclusive sparse features: each row activates one of
+        # every 8-feature group (EFB bundles each group into one column)
+        r = np.random.RandomState(seed)
+        X = np.zeros((n, f))
+        for g in range(0, f, 8):
+            which = r.randint(g, g + 8, size=n)
+            X[np.arange(n), which] = r.rand(n) + 0.5
+        logit = X[:, 0] * 2.0 + X[:, 8] - X[:, 16] + 0.3 * r.randn(n)
+        y = (logit > np.median(logit)).astype(np.float32)
+        return X, y
+""")
+
+
+def test_two_process_efb_matches_single(tmp_path):
+    """EFB under multi-machine training: the greedy bundle plan is built
+    from an allgathered row sample (identical on every rank, like the
+    distributed bin mappers, dataset_loader.cpp:722-807), so ranks grow
+    IDENTICAL models — the hard guarantee. Against single-process EFB
+    the comparison is approximate: the pooled-sample plan can bundle
+    features differently, and the expansion's default-bin
+    reconstruction (node_total - segment mass) carries f32 rounding
+    that legitimately flips near-tie splits (the reference's
+    sample-based distributed construction is approximate the same
+    way)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    (tmp_path / "conftest_data.py").write_text(_DATA_MOD + _SPARSE_DATA)
+    (tmp_path / "worker.py").write_text(_WORKER_EFB.format(repo=repo))
+    ports = [str(_free_port()), str(_free_port())]
+    procs, outs = [], []
+    for rank in range(2):
+        out = tmp_path / f"model_{rank}.txt"
+        outs.append(out)
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=4",
+                   LIGHTGBM_TPU_MACHINE_RANK=str(rank),
+                   TEST_PORTS=",".join(ports),
+                   TEST_OUT=str(out),
+                   PYTHONPATH=str(tmp_path))
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(tmp_path / "worker.py")], env=env,
+            cwd=str(tmp_path), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT))
+    for p in procs:
+        try:
+            out_text, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process EFB training timed out")
+        assert p.returncode == 0, out_text.decode()[-3000:]
+
+    def strip_port(text):
+        return "\n".join(ln for ln in text.splitlines()
+                         if "local_listen_port" not in ln)
+
+    m0 = outs[0].read_text()
+    assert strip_port(m0) == strip_port(outs[1].read_text())
+
+    import lightgbm_tpu as lgb
+    sys.path.insert(0, str(tmp_path))
+    try:
+        from conftest_data import make_sparse_data
+    finally:
+        sys.path.pop(0)
+    X, y = make_sparse_data()
+    single = lgb.train(dict(objective="binary", tree_learner="data",
+                            num_leaves=15, verbosity=-1,
+                            min_data_in_leaf=20, max_bin=15,
+                            boost_from_average=False),
+                       lgb.Dataset(X, label=y,
+                                   params={"max_bin": 15}), 5)
+    multi = lgb.Booster(model_str=m0)
+    a, b = multi.predict(X[:512]), single.predict(X[:512])
+    assert np.corrcoef(a, b)[0, 1] > 0.98
+    assert np.mean(np.abs(a - b)) < 0.05
