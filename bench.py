@@ -19,8 +19,8 @@ import numpy as np
 
 N_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
 N_FEATURES = 28
-NUM_LEAVES = 255
-MAX_BIN = 255
+NUM_LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
+MAX_BIN = int(os.environ.get("BENCH_MAX_BIN", 255))
 WARMUP_TREES = 5
 BENCH_TREES = int(os.environ.get("BENCH_TREES", 100))
 BLOCK_TREES = int(os.environ.get("BENCH_BLOCK_TREES", 10))
@@ -86,89 +86,179 @@ def _probe_with_retry() -> str:
     return problem
 
 
-def main():
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    problem = _probe_with_retry()
-    if problem:
-        # emit a parseable, honest record instead of hanging the driver
-        print(json.dumps({
-            "metric": "higgs1m_trees_per_sec", "value": 0.0,
-            "unit": "trees/sec", "vs_baseline": 0.0}))
-        print(f"# accelerator unreachable: {problem}; no measurement "
-              "possible", file=sys.stderr)
-        return
-    import lightgbm_tpu as lgb
+PARAMS = {"objective": "binary", "num_leaves": NUM_LEAVES,
+          "learning_rate": 0.1, "max_bin": MAX_BIN, "verbosity": -1,
+          "min_data_in_leaf": 20, "use_quantized_grad": True}
+# use_quantized_grad: stochastically-rounded integer gradients with
+# exact leaf refit. A/B at this config (docs/PerfNotes.md round 3):
+# 2.31 vs 1.74 trees/s, AUC@95 0.98119 (quant) vs 0.98092 (exact) —
+# the quantization effect (~2.4e-4) is an order of magnitude below
+# growth-order noise, and the held-out AUC is printed either way
 
-    X, y = make_higgs_like(N_ROWS, N_FEATURES)
-    t0 = time.time()
-    dtrain = lgb.Dataset(X, label=y, params={"max_bin": MAX_BIN})
-    dtrain.construct()
-    bin_time = time.time() - t0
 
-    # use_quantized_grad: stochastically-rounded integer gradients with
-    # exact leaf refit. A/B at this config (docs/PerfNotes.md round 3):
-    # 2.31 vs 1.74 trees/s, AUC@95 0.98119 (quant) vs 0.98092 (exact) —
-    # the quantization effect (~2.4e-4) is an order of magnitude below
-    # growth-order noise, and the held-out AUC is printed below either way
-    params = {"objective": "binary", "num_leaves": NUM_LEAVES,
-              "learning_rate": 0.1, "max_bin": MAX_BIN, "verbosity": -1,
-              "min_data_in_leaf": 20, "use_quantized_grad": True}
-    booster = lgb.Booster(params=params, train_set=dtrain)
-
-    # warmup: compile all jitted phases (incl. the fused multi-tree scan,
-    # boosting/fused.py — one device dispatch per block). Drain via an
-    # actual host transfer (block_until_ready is not reliable through
-    # remoted-accelerator tunnels; a device->host pull cannot complete
-    # before the queue does)
-    block_trees = min(BLOCK_TREES, BENCH_TREES)
-    booster.update_batch(max(1, WARMUP_TREES - 1))
-    booster.update_batch(block_trees)  # compile the bench-block shape
+def _drain(booster):
+    """Force a device->host pull. block_until_ready is not reliable
+    through remoted-accelerator tunnels; a host transfer cannot complete
+    before the device queue does."""
     float(np.asarray(booster.gbdt.train_score[:1])[0])
 
-    # the remoted-accelerator tunnel has run-to-run variance of +-50%
-    # (occasionally 3x, docs/PerfNotes.md); time several blocks and take
-    # the best, the documented measurement methodology for this backend.
-    # BENCH_TREES rounds to whole blocks (at least one).
-    n_blocks = max(1, round(BENCH_TREES / block_trees))
-    block_times = []
-    for _ in range(n_blocks):
-        t1 = time.time()
-        booster.update_batch(block_trees)
-        float(np.asarray(booster.gbdt.train_score[:1])[0])
-        block_times.append(time.time() - t1)
-    rates = sorted(block_trees / b for b in block_times)
-    best_rate = rates[-1]
-    median_rate = rates[len(rates) // 2] if len(rates) % 2 else \
-        0.5 * (rates[len(rates) // 2 - 1] + rates[len(rates) // 2])
 
-    # the tunnel-oscillation rationale for best-block stands (docs/
-    # PerfNotes.md), but the headline reports the MEDIAN so steady-state
-    # is not overstated; best is in the detail line
-    trees_per_sec = median_rate
-    result = {
-        "metric": "higgs1m_trees_per_sec",
-        "value": round(trees_per_sec, 3),
-        "unit": "trees/sec",
-        "vs_baseline": round(trees_per_sec / BASELINE_TREES_PER_SEC, 3),
-    }
-    import jax
-    print(json.dumps(result))
-    blocks = ", ".join(f"{block_trees / b:.2f}" for b in block_times)
-    print(f"# bench detail: {n_blocks} blocks x {block_trees} trees, "
-          f"median {median_rate:.2f} best {best_rate:.2f} trees/sec, "
-          f"per block: [{blocks}], binning {bin_time:.1f}s, "
-          f"device={jax.devices()[0].device_kind}", file=sys.stderr)
-    Xva, yva = make_higgs_like(40_000, N_FEATURES, seed=99)
-    sc = booster.predict(Xva, raw_score=True)
-    from lightgbm_tpu.metrics import AUCMetric  # tie-corrected, no scipy
-    auc = AUCMetric._auc_fast(sc, yva > 0, np.ones_like(yva))
-    print(f"# held-out AUC after {booster.current_iteration()} "
-          f"trees: {auc:.5f}", file=sys.stderr)
-    print("# note: vs_baseline uses the reference's published 10.5M-row "
-          "28-core Higgs rate; same-host single-core reference on THIS "
-          "synthetic 1M-row set measured 2.96 trees/sec "
-          "(docs/PerfNotes.md)", file=sys.stderr)
+class _Bench:
+    """Fault-tolerant measurement driver. Every device interaction goes
+    through train_block(); on a runtime/compile failure it re-probes the
+    backend (with the bounded retry window), rebuilds the booster if the
+    old one's device state died with the fault, and keeps measuring.
+    Partial results beat rc=1 — main() always emits the JSON line from
+    whatever blocks were captured (VERDICT r3 item 1)."""
+
+    def __init__(self, lgb, X, y):
+        self.lgb = lgb
+        self.X, self.y = X, y
+        self.bin_time = 0.0
+        self.booster = None
+        self.dead = False  # backend declared unreachable
+
+    def rebuild(self):
+        t0 = time.time()
+        dtrain = self.lgb.Dataset(self.X, label=self.y,
+                                  params={"max_bin": MAX_BIN})
+        dtrain.construct()
+        self.bin_time = time.time() - t0
+        self.booster = self.lgb.Booster(params=PARAMS, train_set=dtrain)
+
+    def train_block(self, n_trees):
+        """Train n_trees (one fused dispatch when eligible; train_many
+        itself falls back to per-iteration on a fused fault). Returns
+        (wall seconds of the SUCCESSFUL attempt, clean) — probe
+        retries, rebuild/re-binning, the failed attempt, and a
+        post-rebuild recompile warmup stay out of the timing; clean is
+        False when train_many degraded to per-iteration mid-block (the
+        time is real but not representative — callers should keep the
+        trees and drop the sample). (None, False) = backend dead."""
+        if self.dead:
+            return None, False
+        for attempt in (0, 1):
+            try:
+                # test hook: injects a fault ABOVE train_many's own
+                # fallback, exercising this probe/rebuild/retry path
+                from lightgbm_tpu.boosting.gbdt import \
+                    _maybe_inject_fused_fault
+                _maybe_inject_fused_fault("BENCH_INJECT_BLOCK_FAULT")
+                if self.booster is None:
+                    self.rebuild()
+                    # un-timed warmup: the fresh booster's fused scan
+                    # re-traces/recompiles on first dispatch — that cost
+                    # must not land in a measured block
+                    self.booster.update_batch(1)
+                    _drain(self.booster)
+                ff0 = getattr(self.booster.gbdt, "_fused_failures", 0)
+                t1 = time.time()
+                self.booster.update_batch(n_trees)
+                _drain(self.booster)
+                dt = time.time() - t1
+                clean = getattr(self.booster.gbdt, "_fused_failures",
+                                0) <= ff0
+                return dt, clean
+            except Exception as exc:
+                print(f"# block failed (attempt {attempt}): "
+                      f"{type(exc).__name__}: {exc}", file=sys.stderr)
+                problem = _probe_with_retry()
+                if problem:
+                    print(f"# accelerator unreachable after retry window:"
+                          f" {problem}", file=sys.stderr)
+                    self.dead = True
+                    return None, False
+                # backend is healthy again, but the old booster's device
+                # buffers may have died with the fault — rebuild
+                self.booster = None
+        self.dead = True
+        return None, False
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    result = {"metric": "higgs1m_trees_per_sec", "value": 0.0,
+              "unit": "trees/sec", "vs_baseline": 0.0}
+    block_times = []
+    block_trees = min(BLOCK_TREES, BENCH_TREES)
+    bench = None
+    try:
+        problem = _probe_with_retry()
+        if problem:
+            print(f"# accelerator unreachable: {problem}; no measurement "
+                  "possible", file=sys.stderr)
+            return result, block_times, block_trees, None
+        import lightgbm_tpu as lgb
+        X, y = make_higgs_like(N_ROWS, N_FEATURES)
+        bench = _Bench(lgb, X, y)
+        bench.rebuild()
+        # warmup: compile all jitted phases (incl. the fused multi-tree
+        # scan, boosting/fused.py — one device dispatch per block)
+        bench.train_block(max(1, WARMUP_TREES - 1))
+        bench.train_block(block_trees)  # compile the bench-block shape
+
+        # the remoted-accelerator tunnel has run-to-run variance of
+        # +-50% (occasionally 3x, docs/PerfNotes.md); time several
+        # blocks, report the MEDIAN (best in the detail line).
+        n_blocks = max(1, round(BENCH_TREES / block_trees))
+        degraded = []
+        for _ in range(n_blocks):
+            dt, clean = bench.train_block(block_trees)
+            if dt is None:
+                break
+            if clean:
+                block_times.append(dt)
+            else:
+                degraded.append(dt)
+                print(f"# block degraded mid-measurement ({dt:.2f}s); "
+                      "sample dropped from the record", file=sys.stderr)
+        if not block_times and degraded:
+            # an honest degraded number beats an honest zero
+            block_times = degraded
+    except Exception as exc:  # belt and braces: never lose the record
+        print(f"# bench aborted: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
+    if block_times:
+        rates = sorted(block_trees / b for b in block_times)
+        median_rate = rates[len(rates) // 2] if len(rates) % 2 else \
+            0.5 * (rates[len(rates) // 2 - 1] + rates[len(rates) // 2])
+        result["value"] = round(median_rate, 3)
+        result["vs_baseline"] = round(
+            median_rate / BASELINE_TREES_PER_SEC, 3)
+    return result, block_times, block_trees, bench
+
+
+def _report(result, block_times, block_trees, bench):
+    """Detail lines; every step is best-effort so a late fault cannot
+    retract the already-printed JSON record."""
+    try:
+        import jax
+        rates = sorted(block_trees / b for b in block_times)
+        blocks = ", ".join(f"{block_trees / b:.2f}" for b in block_times)
+        print(f"# bench detail: {len(block_times)} blocks x "
+              f"{block_trees} trees, median {result['value']:.2f} best "
+              f"{rates[-1]:.2f} trees/sec, per block: [{blocks}], "
+              f"binning {bench.bin_time:.1f}s, "
+              f"device={jax.devices()[0].device_kind}", file=sys.stderr)
+        Xva, yva = make_higgs_like(40_000, N_FEATURES, seed=99)
+        sc = bench.booster.predict(Xva, raw_score=True)
+        from lightgbm_tpu.metrics import AUCMetric  # tie-corrected
+        auc = AUCMetric._auc_fast(sc, yva > 0, np.ones_like(yva))
+        print(f"# held-out AUC after "
+              f"{bench.booster.current_iteration()} trees: {auc:.5f}",
+              file=sys.stderr)
+        print("# note: vs_baseline uses the reference's published "
+              "10.5M-row 28-core Higgs rate; same-host single-core "
+              "reference on THIS synthetic 1M-row set measured 2.96 "
+              "trees/sec (docs/PerfNotes.md)", file=sys.stderr)
+    except Exception as exc:
+        print(f"# detail reporting failed: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":
-    main()
+    _result, _blocks, _bt, _bench = main()
+    print(json.dumps(_result))
+    sys.stdout.flush()
+    if _blocks and _bench is not None and _bench.booster is not None:
+        _report(_result, _blocks, _bt, _bench)

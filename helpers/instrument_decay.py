@@ -1,0 +1,69 @@
+"""Round-4 decay instrumentation (VERDICT r3 item 2).
+
+Trains the bench shape with the fused loop in DEBUG mode: every tree
+reports (fixup_iters, pre_prune_leaves) from inside the jit, and every
+10-tree block is wall-clock timed. If block time correlates with the
+block's fixup-pass count, the late-tree decay is fixup-bound; if not,
+something else grows.
+
+Usage: python helpers/instrument_decay.py [n_trees] [block]
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax.numpy as jnp  # noqa: E402
+import lightgbm_tpu as lgb  # noqa: E402
+from bench import make_higgs_like, PARAMS, MAX_BIN, N_FEATURES  # noqa: E402
+
+
+def main():
+    n_trees = int(sys.argv[1]) if len(sys.argv) > 1 else 120
+    block = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+    rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
+    X, y = make_higgs_like(rows, N_FEATURES)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": MAX_BIN})
+    ds.construct()
+    bst = lgb.Booster(params=dict(PARAMS), train_set=ds)
+    bst.update()  # iteration 0: normal path (init score plumbing)
+    g = bst.gbdt
+    assert g._fused_eligible(), "bench config must be fused-eligible"
+    run = g._build_fused(debug=True)
+
+    rows_out = []
+    for b in range(n_trees // block):
+        t0 = time.time()
+        score, (stacked, dbg) = run(
+            g.train_score, jnp.asarray(g.iter_, jnp.int32), k=block)
+        g.train_score = score
+        fix = np.asarray(dbg[0])
+        pre = np.asarray(dbg[1])
+        dt = time.time() - t0
+        g.iter_ += block
+        rec = {"block": b, "time_s": round(dt, 3),
+               "trees_per_s": round(block / dt, 3),
+               "fixup_iters": fix.tolist(),
+               "pre_prune_leaves": pre.tolist(),
+               "fixup_sum": int(fix.sum())}
+        rows_out.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    fs = np.array([r["fixup_sum"] for r in rows_out], float)
+    ts = np.array([r["time_s"] for r in rows_out], float)
+    if len(rows_out) > 2 and fs.std() > 0:
+        b1, b0 = np.polyfit(fs, ts, 1)
+        print(f"# fit: block_time = {b0:.2f}s + {b1 * 1000:.1f}ms * "
+              f"fixup_pass  (r={np.corrcoef(fs, ts)[0, 1]:.3f})")
+    print(f"# rates: first3 {np.mean(block / ts[:3]):.2f} "
+          f"last3 {np.mean(block / ts[-3:]):.2f} trees/s")
+
+
+if __name__ == "__main__":
+    main()

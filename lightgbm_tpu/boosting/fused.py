@@ -6,15 +6,25 @@ LGBM_BoosterUpdateOneIter) — cheap on a local device, but on a remoted
 accelerator every crossing pays dispatch/sync latency comparable to the
 tree compute itself (measured ~100 ms/tree through the tunnel,
 docs/PerfNotes.md round 3). The TPU-native reformulation: the boosting
-loop itself is a `lax.scan` whose body grows one tree — objective
-gradients, quantization, growth, prune, exact leaf refit and the score
-update all stay on device — so the host sees ONE dispatch per K trees
-and receives the K stacked TreeArrays plus the advanced scores.
+loop itself is a `lax.scan` whose body grows one tree (or one tree per
+class) — objective gradients, bagging/GOSS sampling, quantization,
+growth, prune, exact leaf refit and the score update all stay on device
+— so the host sees ONE dispatch per K trees and receives the K stacked
+TreeArrays plus the advanced scores.
+
+In-scan sampling (round 4): bagging masks are STATELESS — the mask at
+iteration `it` depends only on (bagging_seed, it - it % bagging_freq),
+so the scan recomputes exactly what the per-iteration path
+(gbdt.py:_bagging, reference gbdt.cpp:183-264) stores; GOSS consumes
+per-iteration keys passed as scan inputs (the same _next_key sequence
+the per-iteration path draws, goss.hpp:76-95), keeping the two paths
+bit-identical. Multiclass grows num_class trees per scan step
+(gbdt.cpp:371 TrainOneIter's per-class loop).
 
 Eligibility is decided by the caller (GBDT.train_many): serial MXU
-growth path, plain gbdt boosting, single tree per iteration, no bagging
-/ GOSS, no validation-score replay, no L1-family leaf renewal — every
-excluded feature falls back to the per-iteration path unchanged.
+growth path, plain gbdt/goss boosting, no validation-score replay, no
+L1-family leaf renewal — every excluded feature falls back to the
+per-iteration path unchanged.
 """
 
 from __future__ import annotations
@@ -29,14 +39,29 @@ __all__ = ["build_fused_train"]
 
 def build_fused_train(*, objective, bins, cnt_weight, feature_mask_fn,
                       num_bins, missing_is_nan, is_cat, grower_kwargs,
-                      shrinkage: float, extra_seed: int, needs_rng: bool):
-    """Return run(score, it0, k) -> (score', stacked TreeArrays).
+                      shrinkage: float, extra_seed: int, needs_rng: bool,
+                      sample_fn=None, num_class: int = 1,
+                      debug: bool = False):
+    """Return run(score, it0, k, sample_keys=None) ->
+    (score', stacked TreeArrays).
 
     `objective.get_gradients` must be pure jnp (all built-in objectives
     are); `grower_kwargs` are the static grow_tree_mxu settings
     (GBDT._mxu_grow_kwargs — shared with the per-iteration path);
     `feature_mask_fn(it)` produces the per-iteration feature_fraction
     mask (traced iteration index).
+
+    sample_fn(grad, hess, it, key) -> (grad', hess', cnt) implements
+    bagging/GOSS inside the scan (None = no sampling; cnt_weight used).
+    For key-consuming samplers (GOSS) the caller passes sample_keys
+    [k, 2] — the same keys the per-iteration path would draw.
+
+    num_class > 1 grows one tree per class per step; stacked tree
+    leaves gain a leading [k, num_class] shape and score is [N, K].
+
+    debug=True additionally stacks per-tree growth counters
+    (fixup_iters, pre_prune_leaves) — the decay instrumentation
+    (docs/PerfNotes.md round 4); stacked becomes (trees, counters).
     """
     from ..learner.grower_mxu import grow_tree_mxu
     from ..learner.histogram_mxu import node_values_mxu
@@ -44,14 +69,14 @@ def build_fused_train(*, objective, bins, cnt_weight, feature_mask_fn,
     shrink = jnp.float32(shrinkage)
     interpret = bool(grower_kwargs.get("interpret", False))
 
-    def body(score, it):
-        grad, hess = objective.get_gradients(score)
-        fmask = feature_mask_fn(it)
+    def one_tree(grad, hess, cnt, fmask, it):
         rng = jax.random.fold_in(jax.random.PRNGKey(extra_seed), it) \
             if needs_rng else None
-        tree, row_node = grow_tree_mxu(
-            bins, grad, hess, cnt_weight, fmask, num_bins,
-            missing_is_nan, is_cat, rng_key=rng, **grower_kwargs)
+        out = grow_tree_mxu(
+            bins, grad, hess, cnt, fmask, num_bins,
+            missing_is_nan, is_cat, rng_key=rng, debug_info=debug,
+            **grower_kwargs)
+        tree, row_node = out[0], out[1]
         # device-side stand-in for the "no further splits" break: a tree
         # that made no split becomes all-zero and the scan carries on
         # (train_one_iter's ok-zeroing, gbdt.py)
@@ -59,11 +84,40 @@ def build_fused_train(*, objective, bins, cnt_weight, feature_mask_fn,
         tree = tree._replace(leaf_value=tree.leaf_value * (shrink * ok))
         vals = node_values_mxu(row_node, tree.leaf_value,
                                interpret=interpret)
-        return score + vals, tree
+        return tree, vals, (out[2] if debug else None)
+
+    def body(score, xs):
+        it, key = xs
+        grad, hess = objective.get_gradients(score)
+        if sample_fn is not None:
+            grad, hess, cnt = sample_fn(grad, hess, it, key)
+        else:
+            cnt = cnt_weight
+        fmask = feature_mask_fn(it)
+        if num_class == 1:
+            tree, vals, dbg = one_tree(grad, hess, cnt, fmask, it)
+            out = (tree, dbg) if debug else tree
+            return score + vals, out
+        trees, dbgs = [], []
+        for cls in range(num_class):
+            t, vals, dbg = one_tree(grad[:, cls], hess[:, cls], cnt,
+                                    fmask, it)
+            score = score.at[:, cls].add(vals)
+            trees.append(t)
+            dbgs.append(dbg)
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *trees)
+        if debug:
+            return score, (stacked,
+                           jax.tree_util.tree_map(
+                               lambda *xs: jnp.stack(xs), *dbgs))
+        return score, stacked
 
     @functools.partial(jax.jit, static_argnames=("k",))
-    def run(score, it0, *, k: int):
+    def run(score, it0, *, k: int, sample_keys=None):
         its = jnp.asarray(it0, jnp.int32) + jnp.arange(k, dtype=jnp.int32)
-        return jax.lax.scan(body, score, its)
+        if sample_keys is None:
+            sample_keys = jnp.zeros((k, 2), jnp.uint32)
+        return jax.lax.scan(body, score, (its, sample_keys))
 
     return run

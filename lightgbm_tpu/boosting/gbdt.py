@@ -17,6 +17,7 @@ differences:
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -36,6 +37,28 @@ from ..utils.timer import global_timer
 from ..utils.file_io import open_file
 
 __all__ = ["GBDT", "create_boosting"]
+
+_FAULT_ENV = "LGBM_TPU_INJECT_FUSED_FAULT"
+
+
+def _maybe_inject_fused_fault(env: str = _FAULT_ENV):
+    """Test hook: fail upcoming fused dispatches on request, so the
+    bench/fallback robustness paths can be exercised without a real
+    device outage. Env format: "N" (fail the next N dispatches) or
+    "S:N" (let S dispatches through, then fail N)."""
+    val = os.environ.get(env, "")
+    if not val:
+        return
+    skip, _, fail = val.partition(":")
+    if not fail:
+        skip, fail = "0", skip
+    skip_n, fail_n = int(skip), int(fail)
+    if skip_n > 0:
+        os.environ[env] = "%d:%d" % (skip_n - 1, fail_n)
+        return
+    if fail_n > 0:
+        os.environ[env] = "0:%d" % (fail_n - 1)
+        raise RuntimeError("injected fused-dispatch fault (test hook)")
 
 
 class GBDT:
@@ -108,7 +131,16 @@ class GBDT:
                               np.asarray(ds.is_categorical),
                               max_bundle_bins=256)
             if plan is not None and plan.effective:
-                self._efb = make_device_tables(plan, ds.default_bins)
+                # feature metadata attaches the segmented-scan tables
+                # (split_bundled.py); without them the MXU path falls
+                # back to per-pass expansion
+                seg = cfg.efb_segmented_scan
+                self._efb = make_device_tables(
+                    plan, ds.default_bins,
+                    num_bins=ds.num_bins if seg else None,
+                    missing_is_nan=(ds.missing_types == 2) if seg
+                    else None,
+                    is_cat=np.asarray(ds.is_categorical) if seg else None)
                 self.bins = jnp.asarray(bundle_matrix(
                     np.asarray(ds.bins), plan))
         if self._efb is None:
@@ -187,17 +219,16 @@ class GBDT:
             # path too (bundle-space histograms + per-pass expansion)
             # when the bundle bins fit bf16 exactness and the expanded
             # scan tensor fits a device-memory budget.
-            efb_mxu_ok = self._efb is None or (
-                cfg.efb_use_mxu and
-                self._efb.bundle_bmax <= 256 and
-                self._mxu_expand_bytes(cfg) <= 1 << 30)
-            if self._forced is None and self._cegb_cfg is None and \
-                    self.bmax <= 256 and not self._mono_nonbasic and \
-                    efb_mxu_ok:
+            excl = self._mxu_exclusions(cfg)
+            if not excl:
                 self._hist_impl = "mxu"
             else:
                 self._hist_impl = "pallas" if self._efb is None \
                     else "scatter"
+                Log.warning(
+                    "training runs on the portable %s grower (MXU path "
+                    "excluded by: %s) — expect ~10x lower throughput on "
+                    "TPU", self._hist_impl, ", ".join(excl))
         else:
             self._hist_impl = "scatter"
         Log.debug("Tree kernel path: %s (backend=%s)", self._hist_impl,
@@ -402,11 +433,17 @@ class GBDT:
                 self.bins, NamedSharding(self.mesh, P()))
         # the MXU growth path composes with data-parallel sharding
         # (per-pass histogram psum); other modes and CPU keep the
-        # portable scatter grower (same gate as the serial choice below)
+        # portable scatter grower (same _mxu_exclusions gate as the
+        # serial kernel choice)
+        excl = self._mxu_exclusions(cfg)
         use_mxu = (cfg.use_pallas and jax.default_backend() != "cpu" and
-                   self.comm.mode == "data" and self.bmax <= 256 and
-                   self._forced is None and self._cegb_cfg is None and
-                   not self._mono_nonbasic and self._efb is None)
+                   self.comm.mode == "data" and not excl)
+        if excl and cfg.use_pallas and jax.default_backend() != "cpu" \
+                and self.comm.mode == "data":
+            Log.warning(
+                "data-parallel training runs on the portable grower "
+                "inside shard_map (MXU path excluded by: %s) — expect "
+                "~10x lower throughput on TPU", ", ".join(excl))
         self._sharded_mxu = use_mxu
         # per-node sampling / extra_trees / quantized rounding need a
         # per-iteration key; it rides into shard_map replicated so every
@@ -468,6 +505,26 @@ class GBDT:
         return jnp.asarray(np.concatenate(
             [np.asarray(s.data) for s in shards]))
 
+    def _mxu_exclusions(self, cfg) -> List[str]:
+        """Why the MXU growth path cannot be used (empty = usable).
+        Single source for the serial kernel choice and the sharded
+        use_mxu gate so the two growers can never drift apart. Forced
+        splits and coupled/split CEGB ride the MXU path (round 4); only
+        the lazy per-row CEGB penalty, non-basic monotone methods, wide
+        bins, and unsuited EFB configs stay portable."""
+        # the expanded-tensor budget only binds on the expansion
+        # fallback; the segmented scan never materializes it
+        efb_ok = self._efb is None or (
+            cfg.efb_use_mxu and self._efb.bundle_bmax <= 256 and
+            (self._efb.scan is not None or
+             self._mxu_expand_bytes(cfg) <= 1 << 30))
+        return [r for r, hit in [
+            ("max_bin > 256", self.bmax > 256),
+            ("monotone_constraints_method", self._mono_nonbasic),
+            ("cegb_penalty_feature_lazy",
+             self._cegb_cfg is not None and self._cegb_cfg.has_lazy),
+            ("efb config", not efb_ok)] if hit]
+
     def _mxu_expand_bytes(self, cfg) -> int:
         """Per-pass expanded scan tensor size under EFB on the MXU path
         ([s_max, F, bmax, 3] f32)."""
@@ -483,7 +540,7 @@ class GBDT:
         the two cannot drift apart."""
         cfg = self.config
         return dict(
-            efb=self._efb,
+            efb=self._efb, forced=self._forced, cegb_cfg=self._cegb_cfg,
             num_leaves=cfg.num_leaves, max_depth=cfg.max_depth,
             hp=self.hp, bmax=self.bmax, monotone=self._monotone,
             interaction_groups=self._interaction_groups,
@@ -507,10 +564,17 @@ class GBDT:
             if needs_rng else None
         if self._grower is None and self._hist_impl == "mxu":
             from ..learner.grower_mxu import grow_tree_mxu
-            return grow_tree_mxu(
+            out = grow_tree_mxu(
                 self.bins, g, h, cnt, feature_mask, self.num_bins_d,
                 self.missing_is_nan_d, self.is_cat_d,
-                rng_key=rng_key, **self._mxu_grow_kwargs())
+                rng_key=rng_key, cegb_state=self._cegb_state,
+                **self._mxu_grow_kwargs())
+            if self._cegb_cfg is not None:
+                tree, row_node, (fu, rfu) = out
+                self._cegb_state = (self._cegb_state[0],
+                                    self._cegb_state[1], fu, rfu)
+                return tree, row_node
+            return out
         if self._grower is None:
             out = grow_tree(
                 self.bins, g, h, cnt, feature_mask, self.num_bins_d,
@@ -642,10 +706,7 @@ class GBDT:
         cfg = self.config
         if cfg.boosting == "goss":
             return self._goss(grad, hess)
-        need = cfg.bagging_freq > 0 and (
-            cfg.bagging_fraction < 1.0 or
-            (cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0))
-        if need and self.iter_ % cfg.bagging_freq == 0:
+        if self._needs_bagging() and self.iter_ % cfg.bagging_freq == 0:
             key = jax.random.fold_in(
                 jax.random.PRNGKey(cfg.bagging_seed), self.iter_)
             u = jax.random.uniform(key, (self.num_data,))
@@ -825,42 +886,113 @@ class GBDT:
 
     # ------------------------------------------------------------------
     # fused multi-tree training (TPU pipelining; boosting/fused.py)
-    def _fused_eligible(self) -> bool:
-        """Whether K iterations can run as one on-device scan with
-        behavior identical to K train_one_iter calls."""
+    def _needs_bagging(self) -> bool:
         cfg = self.config
-        needs_bagging = cfg.bagging_freq > 0 and (
+        return cfg.bagging_freq > 0 and (
             cfg.bagging_fraction < 1.0 or cfg.pos_bagging_fraction < 1.0
             or cfg.neg_bagging_fraction < 1.0)
-        return (type(self) is GBDT and cfg.boosting == "gbdt"
+
+    def _fused_eligible(self) -> bool:
+        """Whether K iterations can run as one on-device scan with
+        behavior identical to K train_one_iter calls. Round 4 widened
+        the ring: bagging masks are recomputed statelessly in-scan, GOSS
+        consumes pre-drawn keys, and multiclass grows one tree per class
+        per step (fused.py)."""
+        cfg = self.config
+        return (type(self) is GBDT and cfg.boosting in ("gbdt", "goss")
                 and self._grower is None and self._hist_impl == "mxu"
-                and self.num_tree_per_iteration == 1
                 and not self.valid_sets and not self._linear
                 and self.objective is not None
                 and not self.objective.need_renew_tree_output
-                and not needs_bagging
-                and self._forced is None and self._cegb_cfg is None)
+                and self._cegb_cfg is None)  # feat_used carries across
+        #       trees (a scan-carry the fused body doesn't thread);
+        #       forced splits are per-tree static and ride along
 
-    def _build_fused(self):
+    def _fused_sample_fn(self):
+        """In-scan bagging/GOSS (fused.py contract): returns
+        (sample_fn | None, needs_keys). Both reproduce the per-iteration
+        path exactly — bagging is stateless on (seed, resample
+        iteration); GOSS consumes the same _next_key draws."""
+        cfg = self.config
+        n = self.num_data
+        if cfg.boosting == "goss":
+            top_rate, other_rate = cfg.top_rate, cfg.other_rate
+            top_k = max(1, int(n * top_rate))
+
+            def goss_fn(grad, hess, it, key):
+                score_abs = jnp.abs(grad) * hess
+                if score_abs.ndim == 2:
+                    score_abs = score_abs.sum(axis=1)
+                thresh = jax.lax.top_k(score_abs, top_k)[0][-1]
+                is_top = score_abs >= thresh
+                u = jax.random.uniform(key, (n,))
+                rest_frac = other_rate / max(1.0 - top_rate, 1e-9)
+                is_other = (~is_top) & (u < rest_frac)
+                amplify = (1.0 - top_rate) / other_rate
+                w = jnp.where(is_top, 1.0,
+                              jnp.where(is_other, amplify, 0.0)) \
+                    .astype(jnp.float32)
+                cnt = (is_top | is_other).astype(jnp.float32)
+                if grad.ndim == 2:
+                    return grad * w[:, None], hess * w[:, None], cnt
+                return grad * w, hess * w, cnt
+
+            return goss_fn, True
+        if self._needs_bagging():
+            use_posneg = (cfg.pos_bagging_fraction < 1.0 or
+                          cfg.neg_bagging_fraction < 1.0)
+            label = jnp.asarray(self.objective.label) if use_posneg \
+                else None
+
+            def bag_fn(grad, hess, it, key):
+                # the mask the per-iteration path STORED at the last
+                # resample boundary, recomputed statelessly
+                it_rs = it - it % cfg.bagging_freq
+                k2 = jax.random.fold_in(
+                    jax.random.PRNGKey(cfg.bagging_seed), it_rs)
+                u = jax.random.uniform(k2, (n,))
+                if use_posneg:
+                    frac = jnp.where(label > 0, cfg.pos_bagging_fraction,
+                                     cfg.neg_bagging_fraction)
+                    mask = (u < frac).astype(jnp.float32)
+                else:
+                    mask = (u < cfg.bagging_fraction).astype(jnp.float32)
+                if grad.ndim == 2:
+                    return grad * mask[:, None], hess * mask[:, None], mask
+                return grad * mask, hess * mask, mask
+
+            return bag_fn, False
+        return None, False
+
+    def _build_fused(self, debug: bool = False):
         from .fused import build_fused_train
         cfg = self.config
         needs_rng = (cfg.feature_fraction_bynode < 1.0 or cfg.extra_trees
                      or cfg.use_quantized_grad)
-        return build_fused_train(
+        sample_fn, needs_keys = self._fused_sample_fn()
+        self._fused_needs_keys = needs_keys
+        return build_fused_train(debug=debug,
             objective=self.objective, bins=self.bins,
             cnt_weight=jnp.ones(self.num_data, jnp.float32),
             feature_mask_fn=self._feature_mask_at,
             num_bins=self.num_bins_d, missing_is_nan=self.missing_is_nan_d,
             is_cat=self.is_cat_d, grower_kwargs=self._mxu_grow_kwargs(),
             shrinkage=self.shrinkage_rate, extra_seed=cfg.extra_seed,
-            needs_rng=needs_rng)
+            needs_rng=needs_rng, sample_fn=sample_fn,
+            num_class=self.num_tree_per_iteration)
 
     def train_many(self, k: int) -> bool:
         """K boosting iterations with one device dispatch (and at most
         one amortized host sync) — behavior-identical to K
         train_one_iter calls when eligible, else a plain loop. Returns
         True when training cannot continue (lagged stall detection, as
-        in train_one_iter)."""
+        in train_one_iter).
+
+        Resilience: a runtime/compile failure inside the fused dispatch
+        (remoted-accelerator tunnels can drop mid-request) falls back to
+        the per-iteration path for this batch instead of propagating;
+        after two consecutive fused failures the fused path is disabled
+        for the rest of this booster's life."""
         if self.iter_ == 0 and k > 0:
             # the first iteration owns boost_from_average / init-score
             # plumbing (host-side floats); run it on the normal path
@@ -869,7 +1001,8 @@ class GBDT:
             k -= 1
         if k <= 0:
             return False
-        if not self._fused_eligible():
+        if not self._fused_eligible() or getattr(
+                self, "_fused_disabled", False):
             # complete the whole batch like the fused path does (extra
             # iterations on a stalled model append harmless constant
             # trees), so batch size and iteration count never depend on
@@ -878,17 +1011,49 @@ class GBDT:
             for _ in range(k):
                 stop = self.train_one_iter() or stop
             return stop
-        if getattr(self, "_fused_run", None) is None:
-            self._fused_run = self._build_fused()
-        with global_timer.timeit("tree_train"):
-            score, stacked = self._fused_run(
-                self.train_score, jnp.asarray(self.iter_, jnp.int32), k=k)
+        saved_rng = self._rng_key
+        try:
+            _maybe_inject_fused_fault()
+            if getattr(self, "_fused_run", None) is None:
+                self._fused_run = self._build_fused()
+            keys = None
+            if getattr(self, "_fused_needs_keys", False):
+                # the same _next_key sequence the per-iteration GOSS
+                # path would draw, pre-drawn as scan inputs
+                keys = jnp.stack([self._next_key() for _ in range(k)])
+            with global_timer.timeit("tree_train"):
+                score, stacked = self._fused_run(
+                    self.train_score, jnp.asarray(self.iter_, jnp.int32),
+                    k=k, sample_keys=keys)
+        except Exception as exc:  # device/compile faults must not kill
+            # rewind the RNG stream so the per-iteration fallback draws
+            # the IDENTICAL key sequence the fused dispatch consumed —
+            # a transient fault must not change the trained model
+            self._rng_key = saved_rng
+            self._fused_failures = getattr(self, "_fused_failures", 0) + 1
+            self._fused_run = None  # closure may hold dead executables
+            if self._fused_failures >= 2:
+                self._fused_disabled = True
+            Log.warning(
+                "fused multi-tree dispatch failed (%s: %s); falling back "
+                "to per-iteration training for this batch%s"
+                % (type(exc).__name__, exc,
+                   " and disabling the fused path" if
+                   getattr(self, "_fused_disabled", False) else ""))
+            stop = False
+            for _ in range(k):
+                stop = self.train_one_iter() or stop
+            return stop
+        self._fused_failures = 0
         self.train_score = score
+        kcls = self.num_tree_per_iteration
         for i in range(k):
-            self.trees.append(
-                jax.tree_util.tree_map(lambda a: a[i], stacked))
-            self.tree_class.append(0)
-            self.linear_models.append(None)
+            for c in range(kcls):
+                self.trees.append(jax.tree_util.tree_map(
+                    (lambda a: a[i, c]) if kcls > 1 else (lambda a: a[i]),
+                    stacked))
+                self.tree_class.append(c if kcls > 1 else 0)
+                self.linear_models.append(None)
         self.iter_ += k
         # lagged stall poll (see train_one_iter): a stalled model keeps
         # producing all-zero trees, so checking the batch's last tree
@@ -900,6 +1065,8 @@ class GBDT:
         stop_hint = (prev is not None and not self._exact_stop_poll and
                      crossed and int(prev) <= 1)
         pending = stacked.num_leaves[k - 1]
+        if kcls > 1:
+            pending = jnp.max(pending)  # stalled only if EVERY class is
         try:
             pending.copy_to_host_async()
         except Exception:

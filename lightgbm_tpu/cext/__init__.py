@@ -88,6 +88,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
         c_ip, ctypes.c_int,
         c_dp, ctypes.POINTER(ctypes.c_long), c_ip, c_ip,
         ctypes.c_int, ctypes.c_void_p]
+    lib.lgbt_find_numeric_bounds.restype = ctypes.c_int
+    lib.lgbt_find_numeric_bounds.argtypes = [
+        c_dp, ctypes.c_int, ctypes.c_long, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int,
+        c_dp, c_ip, c_ip, c_dp, ctypes.POINTER(ctypes.c_long)]
     _lib = lib
     return _lib
 
@@ -164,6 +169,37 @@ def values_to_bins_u8(values: np.ndarray, bounds: np.ndarray,
         num_search, nan_bin,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
     return out
+
+
+def find_numeric_bounds(sample_t: np.ndarray, max_bin: int,
+                        min_data_in_bin: int, use_missing: bool,
+                        zero_as_missing: bool):
+    """Whole-matrix numeric boundary search (native FindBin loop over
+    features, OpenMP). sample_t: [F, S] contiguous f64 raw sample.
+    Returns (bounds_list[F], missing_type[F], minmax[F,2],
+    zero_na[F,2])."""
+    lib = get_lib()
+    assert lib is not None
+    sample_t = np.ascontiguousarray(sample_t, np.float64)
+    n_feat, s = sample_t.shape
+    stride = max_bin + 2
+    bounds = np.empty(n_feat * stride, np.float64)
+    nb = np.empty(n_feat, np.int32)
+    mtype = np.empty(n_feat, np.int32)
+    minmax = np.empty((n_feat, 2), np.float64)
+    zero_na = np.empty((n_feat, 2), np.int64)
+    lib.lgbt_find_numeric_bounds(
+        sample_t.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        n_feat, s, max_bin, min_data_in_bin, int(use_missing),
+        int(zero_as_missing),
+        bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        nb.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        mtype.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        minmax.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        zero_na.ctypes.data_as(ctypes.POINTER(ctypes.c_long)))
+    blist = [bounds[j * stride: j * stride + nb[j]].copy()
+             for j in range(n_feat)]
+    return blist, mtype, minmax, zero_na
 
 
 def bin_matrix(X: np.ndarray, feat_idx: np.ndarray, bounds_flat: np.ndarray,

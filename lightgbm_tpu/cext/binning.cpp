@@ -315,4 +315,137 @@ void lgbt_bin_matrix(const void* Xv, int x_is_f32, long n, int f_total,
   }
 }
 
+// ---------------------------------------------------------------------------
+// Whole-matrix numeric bin-boundary search (the per-feature FindBin loop of
+// DatasetLoader::ConstructBinMappersFromTextData, dataset_loader.cpp:~690,
+// with bin.cpp:325-404 FindBin + :256 FindBinWithZeroAsOneBin semantics).
+// Behavior-exact mirror of binning.py from_sample's numeric path so the
+// native and NumPy pipelines produce identical mappers.
+//
+// sample_t: [n_feat, s] feature-major contiguous sample (raw values incl.
+// zeros and NaNs). Per feature writes <= max_bin+1 bounds at stride
+// (max_bin + 2) into bounds_out plus the mapper metadata scalars.
+// ---------------------------------------------------------------------------
+static int zero_as_one_bin(const double* distinct, const int* counts,
+                           int n, int max_bin, long total_cnt,
+                           int min_data_in_bin, double* out) {
+  // mirror of binning.py _find_bin_zero_as_one
+  const double kZero = 1e-35;
+  const double kInf = std::numeric_limits<double>::infinity();
+  if (n == 0) {
+    out[0] = kInf;
+    return 1;
+  }
+  long left_cnt_data = 0, right_cnt_data = 0;
+  int left_cnt = n, right_start = -1;
+  for (int i = 0; i < n; ++i) {
+    if (distinct[i] <= -kZero) {
+      left_cnt_data += counts[i];
+    } else if (distinct[i] > kZero) {
+      right_cnt_data += counts[i];
+      if (right_start < 0) right_start = i;
+    }
+    if (distinct[i] > -kZero && left_cnt == n) left_cnt = i;
+  }
+  int nb = 0;
+  if (left_cnt > 0) {
+    int left_max_bin = std::max(
+        1, static_cast<int>(static_cast<double>(left_cnt_data) /
+                            std::max<long>(total_cnt, 1) / 2.0 *
+                            (max_bin - 1)));
+    nb = lgbt_greedy_find_bin(distinct, counts, left_cnt, left_max_bin,
+                              left_cnt_data, min_data_in_bin, out);
+    out[nb - 1] = -kZero;
+  }
+  if (right_start >= 0) {
+    int right_max_bin = max_bin - 1 - nb;
+    if (right_max_bin > 0) {
+      out[nb++] = kZero;
+      nb += lgbt_greedy_find_bin(distinct + right_start,
+                                 counts + right_start, n - right_start,
+                                 right_max_bin, right_cnt_data,
+                                 min_data_in_bin, out + nb);
+    } else {
+      out[nb++] = kInf;
+    }
+  } else {
+    out[nb++] = kInf;
+  }
+  return nb;
+}
+
+int lgbt_find_numeric_bounds(const double* sample_t, int n_feat, long s,
+                             int max_bin, int min_data_in_bin,
+                             int use_missing, int zero_as_missing,
+                             double* bounds_out, int* nb_out,
+                             int* mtype_out, double* minmax_out,
+                             long* zero_na_out) {
+  const double kZero = 1e-35;
+  const int stride = max_bin + 2;
+#if defined(_OPENMP)
+#pragma omp parallel
+#endif
+  {
+    std::vector<double> vals(s), dvals(s + 1);
+    std::vector<int> dcnts(s + 1);
+#if defined(_OPENMP)
+#pragma omp for schedule(dynamic)
+#endif
+    for (int fj = 0; fj < n_feat; ++fj) {
+      const double* col = sample_t + static_cast<long>(fj) * s;
+      long nv = 0, na = 0;
+      for (long i = 0; i < s; ++i) {
+        double v = col[i];
+        if (std::isnan(v)) {
+          ++na;
+        } else if (std::fabs(v) > kZero) {
+          vals[nv++] = v;
+        }
+      }
+      long zero_cnt = s - nv - na;
+      int mtype = 0;  // NONE
+      if (use_missing) {
+        if (zero_as_missing) mtype = 1;       // ZERO
+        else if (na > 0) mtype = 2;           // NAN
+      }
+      std::sort(vals.begin(), vals.begin() + nv);
+      int nd = lgbt_distinct(vals.data(), static_cast<int>(nv),
+                             dvals.data(), dcnts.data());
+      if (zero_cnt > 0 || nd == 0) {
+        // splice zero at its sorted position (binning.py:205-209)
+        int pos = static_cast<int>(
+            std::lower_bound(dvals.data(), dvals.data() + nd, 0.0) -
+            dvals.data());
+        if (pos >= nd || std::fabs(dvals[pos]) > kZero) {
+          for (int i = nd; i > pos; --i) {
+            dvals[i] = dvals[i - 1];
+            dcnts[i] = dcnts[i - 1];
+          }
+          dvals[pos] = 0.0;
+          dcnts[pos] = static_cast<int>(std::max<long>(zero_cnt, 0));
+          ++nd;
+        }
+      }
+      minmax_out[2 * fj] = nd ? dvals[0] : 0.0;
+      minmax_out[2 * fj + 1] = nd ? dvals[nd - 1] : 0.0;
+      double* bout = bounds_out + static_cast<long>(fj) * stride;
+      int nb;
+      if (mtype == 2) {
+        nb = zero_as_one_bin(dvals.data(), dcnts.data(), nd, max_bin - 1,
+                             s - na, min_data_in_bin, bout);
+        bout[nb++] = std::numeric_limits<double>::quiet_NaN();
+      } else {
+        nb = zero_as_one_bin(dvals.data(), dcnts.data(), nd, max_bin,
+                             s, min_data_in_bin, bout);
+        if (mtype == 1 && nb == 2) mtype = 0;  // ZERO w/o split -> NONE
+      }
+      nb_out[fj] = nb;
+      mtype_out[fj] = mtype;
+      zero_na_out[2 * fj] = zero_cnt;
+      zero_na_out[2 * fj + 1] = na;
+    }
+  }
+  return 0;
+}
+
 }  // extern "C"

@@ -257,13 +257,19 @@ _PARAMS: List[ParamSpec] = [
        "many splits per pass before re-ranking (approaches the "
        "reference's strict best-first order, serial_tree_learner.cpp:159, "
        "as the cap shrinks). 0 = unthrottled batched growth"),
-    _p("efb_use_mxu", bool, False, (),
-       desc="route EFB-bundled training through the MXU growth path "
-            "(bundle-space histogram kernels + per-pass expansion to "
-            "original features). Parity-tested but measured SLOWER than "
-            "the portable grower at 200k x 1000 x 63-bin shapes (the "
-            "expansion dominates at wide F); kept opt-in until the "
-            "segmented bundle-space split scan lands"),
+    _p("efb_use_mxu", bool, True, (),
+       desc="route EFB-bundled training through the MXU growth path: "
+            "bundle-space histogram kernels + the segmented bundle-space "
+            "split scan (split_bundled.py — the reference's sub-feature "
+            "offset scan, feature_histogram.hpp over feature_group.h "
+            "ranges). false falls back to the portable scatter grower "
+            "for bundled data"),
+    _p("efb_segmented_scan", bool, True, (),
+       desc="scan bundled histograms directly per sub-feature segment "
+            "([S, Fb, Bb] stays bundle-sized; split_bundled.py). false "
+            "reverts to per-pass expansion to original features "
+            "(efb.expand_histograms) — slower at wide F, kept as the "
+            "parity baseline"),
     _p("bin_pack_4bit", bool, True, ("four_bit_bins",),
        desc="store the device bin matrix two-features-per-byte when "
             "every feature fits 4 bits (max_bin <= 15; the reference's "

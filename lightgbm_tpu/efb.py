@@ -190,6 +190,33 @@ def bundle_matrix(bins: np.ndarray, plan: EfbPlan) -> np.ndarray:
     return out
 
 
+class EfbScan(NamedTuple):
+    """Static tables for the SEGMENTED bundle-space split scan
+    (split_bundled.py) — the reference's per-sub-feature offset scan
+    over the bundled histogram (feature_histogram.hpp offsets over
+    feature_group.h ranges), reformulated positionally: every bundle
+    position (g, p) hosts at most ONE numeric threshold candidate, and
+    its left-side sums are two csum gathers plus the reconstructed
+    default mass. Scan tensors stay [S, Fb, Bb] — no expansion.
+
+    The candidate<->position bijection: feature f with nb bins has nb-1
+    non-default positions and at most nb-1 valid thresholds; threshold
+    t != default sits at its own position, and t == default (which has
+    no position) is hosted by the position of local bin nb-1 (never a
+    threshold itself)."""
+    fid: object                     # [Fb, Bb] i32 original feature (-1 pad)
+    cand_t: object                  # [Fb, Bb] i32 hosted threshold (-1)
+    prefix_flat: object             # [Fb, Bb] i32 csum idx, -1 = empty
+    incl_def: object                # [Fb, Bb] bool add default mass left
+    seg_lo_m1_flat: object          # [Fb, Bb] i32 csum idx below segment
+    seg_hi_flat: object             # [Fb, Bb] i32 csum idx at segment end
+    is_multi_pos: object            # [Fb, Bb] bool feature shares column
+    nan_flat: object                # [Fb, Bb] i32 NaN-bin hist idx
+    #                                 (-1: NaN bin IS the default bin)
+    has_nan_pos: object             # [Fb, Bb] bool feature has NaN bin
+    cat_feats: object               # [Fc] i32 categorical feature ids
+
+
 class EfbDev(NamedTuple):
     """Device-side static tables. All fields are arrays so the tuple
     rides through jit as a pytree; the static ints (Fb, Bb) are derived
@@ -207,6 +234,7 @@ class EfbDev(NamedTuple):
     is_valid_pos: object            # [F, bmax] bool
     loc_table: object               # [F, Bb] i32
     num_cols_arr: object            # [Fb] placeholder carrying Fb shape
+    scan: object = None             # EfbScan | None (segmented split scan)
 
     @property
     def num_cols(self) -> int:
@@ -217,7 +245,80 @@ class EfbDev(NamedTuple):
         return self.loc_table.shape[1]
 
 
-def make_device_tables(plan: EfbPlan, default_bins: np.ndarray) -> EfbDev:
+def _make_scan_tables(plan: EfbPlan, default_bins: np.ndarray,
+                      num_bins: np.ndarray, missing_is_nan: np.ndarray,
+                      is_cat: np.ndarray):
+    """Host construction of the EfbScan position tables (see EfbScan)."""
+    import jax.numpy as jnp
+    fb, bb = plan.num_cols, plan.bundle_bmax
+    fid = np.full((fb, bb), -1, np.int32)
+    cand_t = np.full((fb, bb), -1, np.int32)
+    prefix_flat = np.full((fb, bb), -1, np.int32)
+    incl_def = np.zeros((fb, bb), bool)
+    seg_lo_m1 = np.full((fb, bb), -1, np.int32)
+    seg_hi_f = np.zeros((fb, bb), np.int32)
+    is_multi_p = np.zeros((fb, bb), bool)
+    nan_flat = np.full((fb, bb), -1, np.int32)
+    has_nan_p = np.zeros((fb, bb), bool)
+    f = plan.col_of_feat.shape[0]
+    for fi in range(f):
+        g = int(plan.col_of_feat[fi])
+        nb = int(num_bins[fi])
+        db = int(default_bins[fi])
+        nan = bool(missing_is_nan[fi])
+        # every position of fi gets its feature id + segment/nan info
+        pos_list = [int(plan.pos_of_local[fi, b]) for b in range(nb)
+                    if plan.pos_of_local[fi, b] >= 0]
+        p_nan = int(plan.pos_of_local[fi, nb - 1]) if nan else -1
+        for p in pos_list:
+            fid[g, p] = fi
+            seg_lo_m1[g, p] = g * bb + plan.seg_lo[fi] - 1 \
+                if plan.seg_lo[fi] > 0 else -1
+            seg_hi_f[g, p] = g * bb + plan.seg_hi[fi]
+            is_multi_p[g, p] = bool(plan.is_multi[fi])
+            has_nan_p[g, p] = nan
+            nan_flat[g, p] = g * bb + p_nan if p_nan >= 0 else -1
+        if is_cat[fi]:
+            continue                    # cats go through the sub-scan
+        t_lim = nb - 2 - (1 if nan else 0)
+        for t in range(t_lim + 1):
+            if t == db and plan.is_multi[fi]:
+                continue                # hosted below
+            p = int(plan.pos_of_local[fi, t])
+            if p < 0:
+                continue
+            cand_t[g, p] = t
+            prefix_flat[g, p] = g * bb + p
+            incl_def[g, p] = bool(plan.is_multi[fi]) and db < t
+        if plan.is_multi[fi] and db <= t_lim:
+            # t == default has no position; host it on local nb-1's
+            # position (never a threshold: nb-1 > t_lim always)
+            p_host = int(plan.pos_of_local[fi, nb - 1])
+            assert p_host >= 0, "default bin must differ from last local"
+            cand_t[g, p_host] = db
+            prefix_flat[g, p_host] = \
+                g * bb + int(plan.pos_of_local[fi, db - 1]) if db > 0 \
+                else -1
+            incl_def[g, p_host] = True
+    cat_feats = np.nonzero(np.asarray(is_cat))[0].astype(np.int32)
+    return EfbScan(
+        fid=jnp.asarray(fid), cand_t=jnp.asarray(cand_t),
+        prefix_flat=jnp.asarray(prefix_flat),
+        incl_def=jnp.asarray(incl_def),
+        seg_lo_m1_flat=jnp.asarray(seg_lo_m1),
+        seg_hi_flat=jnp.asarray(seg_hi_f),
+        is_multi_pos=jnp.asarray(is_multi_p),
+        nan_flat=jnp.asarray(nan_flat),
+        has_nan_pos=jnp.asarray(has_nan_p),
+        cat_feats=jnp.asarray(cat_feats))
+
+
+def make_device_tables(plan: EfbPlan, default_bins: np.ndarray,
+                       num_bins: Optional[np.ndarray] = None,
+                       missing_is_nan: Optional[np.ndarray] = None,
+                       is_cat: Optional[np.ndarray] = None) -> EfbDev:
+    """Build the device tables; when the feature metadata is supplied the
+    segmented-scan tables (EfbScan) are attached too."""
     import jax.numpy as jnp
     f, bmax = plan.pos_of_local.shape
     bb = plan.bundle_bmax
@@ -230,6 +331,11 @@ def make_device_tables(plan: EfbPlan, default_bins: np.ndarray) -> EfbDev:
         in_seg = (p >= plan.seg_lo[fi]) & (p <= plan.seg_hi[fi])
         loc[fi] = np.where(in_seg, plan.local_of_pos[g],
                            default_bins[fi])
+    scan = None
+    if num_bins is not None and missing_is_nan is not None and \
+            is_cat is not None:
+        scan = _make_scan_tables(plan, default_bins, num_bins,
+                                 missing_is_nan, is_cat)
     return EfbDev(
         col_of_feat=jnp.asarray(plan.col_of_feat),
         seg_lo=jnp.asarray(plan.seg_lo),
@@ -238,7 +344,8 @@ def make_device_tables(plan: EfbPlan, default_bins: np.ndarray) -> EfbDev:
         is_default_pos=jnp.asarray(plan.pos_of_local == -1),
         is_valid_pos=jnp.asarray(plan.pos_of_local >= 0),
         loc_table=jnp.asarray(loc),
-        num_cols_arr=jnp.zeros(plan.num_cols, jnp.int8))
+        num_cols_arr=jnp.zeros(plan.num_cols, jnp.int8),
+        scan=scan)
 
 
 def expand_histograms(hist_b, efb: EfbDev):

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -39,7 +40,7 @@ from .histogram_mxu import (_round_up, build_histograms_mxu_auto, fits_v2,
                             node_values_mxu, pack_route_tables,
                             quantize_gradients, route_rows_mxu)
 from .split import (BestSplits, SplitHyperParams, find_best_splits,
-                    leaf_output)
+                    leaf_gain, leaf_output, _split_gain)
 from .split_kernel import find_best_splits_kernel, kernel_supports
 
 __all__ = ["grow_tree_mxu"]
@@ -47,7 +48,7 @@ __all__ = ["grow_tree_mxu"]
 
 def _prune_to_best_first(tree: TreeArrays, row_node: jax.Array, *,
                          num_leaves: int, m_grow: int, interpret: bool,
-                         aux: Tuple = ()) -> Tuple:
+                         aux: Tuple = (), rank_gain=None) -> Tuple:
     """Replay the reference's strict best-first growth order
     (serial_tree_learner.cpp:159-210) over an OVERGROWN tree's recorded
     split gains, keep the winning num_leaves-1 splits, and compact.
@@ -66,7 +67,12 @@ def _prune_to_best_first(tree: TreeArrays, row_node: jax.Array, *,
     mf = 2 * num_leaves - 1
     mf1 = mf + 1
     has_split = tree.left >= 0
-    gains = jnp.where(has_split, tree.gain, -jnp.inf)
+    # rank_gain overrides the replay ORDER only (forced splits outrank
+    # every gain-chosen candidate, serial_tree_learner.cpp:459); the
+    # tree keeps its true recorded gains
+    gains = jnp.where(has_split,
+                      tree.gain if rank_gain is None else rank_gain,
+                      -jnp.inf)
 
     # greedy selection: pop the max-gain available node, make its
     # children available (the reference's leaf queue, with all gains
@@ -172,7 +178,7 @@ def _kernel_cap(s: int) -> int:
                      "interpret", "hist_double_prec", "tail_split_cap",
                      "hist_subtraction", "overshoot", "psum_axis",
                      "quantized_grad", "use_scan_kernel", "packed4",
-                     "debug_info"))
+                     "cegb_cfg", "debug_info"))
 def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   cnt_weight: jax.Array, feature_mask: jax.Array,
                   num_bins: jax.Array, missing_is_nan: jax.Array,
@@ -192,6 +198,9 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   use_scan_kernel: bool = False,
                   packed4: bool = False,
                   efb=None,
+                  forced=None,
+                  cegb_cfg=None,
+                  cegb_state=None,
                   debug_info: bool = False
                   ) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree; same contract as grower.grow_tree (serial mode).
@@ -322,6 +331,30 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     feat_tbl = jnp.stack([num_bins.astype(jnp.float32),
                           missing_is_nan.astype(jnp.float32)], axis=1)
 
+    # Forced splits (reference SerialTreeLearner::ForceSplits,
+    # serial_tree_learner.cpp:459) and CEGB penalties
+    # (cost_effective_gradient_boosting.hpp) on the MXU path — same
+    # semantics as the portable grower (grower.py:266-300). The lazy
+    # per-row CEGB penalty is NOT supported here (it needs an [N, F]
+    # charge matrix rebuilt per pass); callers route has_lazy configs to
+    # the portable grower.
+    use_forced = forced is not None
+    if use_forced:
+        forced_feat, forced_bin, forced_left, forced_right = forced
+        n_spec = forced_feat.shape[0]
+    use_cegb = cegb_cfg is not None
+    if use_cegb:
+        if cegb_cfg.has_lazy:
+            raise NotImplementedError(
+                "cegb_penalty_feature_lazy runs on the portable grower")
+        cegb_coupled, _cegb_lazy, feat_used0, row_feat_used0 = cegb_state
+    else:
+        feat_used0 = jnp.zeros(1, bool)
+    node_force0 = (jnp.full(m1, -1, jnp.int32).at[0].set(0)
+                   if use_forced else jnp.full(1, -1, jnp.int32))
+    forced_ok0 = jnp.zeros(m1 if use_forced else 1, bool)
+    was_forced0 = jnp.zeros(m1 if use_forced else 1, bool)
+
     def hist_cfg(s):
         # empirically tuned on v5e: wider feature chunks while the output
         # block fits comfortably in VMEM, narrower for big frontiers
@@ -346,7 +379,8 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # (original-feature one-hots + loc decode) needs the small block
         # to stay inside VMEM at wide F
         rb = 1024 if efb is not None else \
-            (2048 if nslots <= 64 else 4096)
+            (int(os.environ.get("LGBM_TPU_RB_SMALL", 2048))
+             if nslots <= 64 else 4096)
         if fits_v2(nslots, fk, bk, hist_double_prec, quant,
                    route_width=f if efb is not None else 0,
                    row_block=rb):
@@ -378,7 +412,8 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         so committed splits' children fit it)."""
         (tree, row_node, tbl_c, member_c, slot_nodes, best, cons_min,
          cons_max, path_mask, done, parent_hist, pair_parent, pair_sleft,
-         pair_kstart) = st
+         pair_kstart, node_force, forced_ok_st, feat_used,
+         was_forced) = st
         sn = slot_nodes[:s]
         if sk_next is None:
             sk_next = _kernel_cap(min(2 * s, s_max)) if hist_subtraction \
@@ -434,14 +469,16 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         else:
             hist, row_node = sweep(row_node, tbl_c, member_c, s,
                                    m_cap=m_cap)
-        if efb is not None:
-            # subtraction/parent state live in bundle space (above);
-            # the split scan runs on original features — expand here
-            # (linear, so it commutes with the psum and the sibling
-            # subtraction; efb.expand_histograms)
+        if efb is not None and efb.scan is None:
+            # expansion fallback: subtraction/parent state live in
+            # bundle space (above); the split scan runs on original
+            # features — expand here (linear, so it commutes with the
+            # psum and the sibling subtraction; efb.expand_histograms)
             from ..efb import expand_histograms
             hist_scan = expand_histograms(hist, efb)
         else:
+            # unbundled, or bundled with the segmented scan (which
+            # consumes the bundle-space histogram directly)
             hist_scan = hist
 
         slot_fmask = jnp.broadcast_to(feature_mask[None, :], (s, f))
@@ -464,13 +501,36 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             kr = jax.random.fold_in(jax.random.fold_in(rng_key, 7919),
                                     pass_idx)
             rand_bins = jax.random.randint(kr, (s, f), 0, bmax)
+        if use_cegb:
+            # per-(slot, feature) DeltaGain penalty (reference
+            # CostEfficientGradientBoosting::DetlaGain; the portable
+            # form at grower.py:375-393 minus the lazy term)
+            gp = cegb_cfg.tradeoff * cegb_cfg.penalty_split * \
+                tree.count[sn][:, None] * jnp.ones((s, f), jnp.float32)
+            if cegb_cfg.has_coupled:
+                gp += cegb_cfg.tradeoff * cegb_coupled[None, :] * \
+                    (~feat_used)[None, :].astype(jnp.float32)
+        else:
+            gp = None
 
         # fused single-launch scan kernel (split_kernel.py, the
         # CUDABestSplitFinder analog). Measured ~4% SLOWER than the XLA
         # scan in-context on v5e (the scan is NOT this backend's
         # bottleneck; XLA fuses it well) — kept opt-in for backends
         # where launch overhead dominates.
-        if use_scan_kernel and kernel_supports(hp) and rand_bins is None:
+        if efb is not None and efb.scan is not None:
+            # segmented bundle-space scan: [S, Fb, Bb] in, original-
+            # feature BestSplits out (split_bundled.py)
+            from .split_bundled import find_best_splits_bundled
+            bs = find_best_splits_bundled(
+                hist_scan, tree.sum_grad[sn], tree.sum_hess[sn],
+                tree.count[sn], tree.leaf_value[sn], num_bins,
+                missing_is_nan, is_cat_feat, slot_fmask, hp, efb,
+                monotone=monotone, cons_min=cons_min[sn],
+                cons_max=cons_max[sn], depth=tree.depth[sn],
+                rand_bins=rand_bins, gain_penalty=gp)
+        elif use_scan_kernel and kernel_supports(hp) and \
+                rand_bins is None and gp is None:
             bs = find_best_splits_kernel(
                 hist_scan, tree.sum_grad[sn], tree.sum_hess[sn],
                 tree.count[sn],
@@ -485,7 +545,79 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 tree.leaf_value[sn], num_bins, missing_is_nan, is_cat_feat,
                 slot_fmask, hp, monotone=monotone, cons_min=cons_min[sn],
                 cons_max=cons_max[sn], depth=tree.depth[sn],
-                rand_bins=rand_bins)
+                rand_bins=rand_bins, gain_penalty=gp)
+
+        if use_forced:
+            # override gain-chosen splits on forced nodes with the
+            # spec's (feature, threshold) — stats gathered from the scan
+            # tensor like FeatureHistogram::GatherInfoForThreshold
+            # (feature_histogram.hpp:862+; portable form grower.py:456).
+            # The sweep already psum'd the histograms, so sums are
+            # global here under data-parallel.
+            nf_slot = node_force[sn]                         # [S]
+            has_f = (nf_slot >= 0) & (sn < m)
+            sp = jnp.clip(nf_slot, 0, n_spec - 1)
+            ff = jnp.clip(forced_feat[sp], 0, f - 1)         # [S]
+            fb_t = forced_bin[sp]
+            if efb is not None and efb.scan is not None:
+                # bundle-space: expand ONE feature per slot (the same
+                # gather + default-mass reconstruction as
+                # efb.expand_histograms, restricted to ff[slot])
+                bbw = hist_scan.shape[2]
+                flath = hist_scan.reshape(s, -1, 3)
+                csum_b = jnp.cumsum(hist_scan, axis=2).reshape(s, -1, 3)
+                fp = efb.flat_pos[ff]                        # [S, bmax]
+                gath = jnp.take_along_axis(flath, fp[..., None], axis=1)
+                total_b = jnp.sum(hist_scan[:, 0], axis=1)   # [S, 3]
+                colf = efb.col_of_feat[ff]
+                hi_i = colf * bbw + efb.seg_hi[ff]
+                lo_gate = (efb.seg_lo[ff] > 0)[:, None]
+                lo_i = colf * bbw + jnp.maximum(efb.seg_lo[ff] - 1, 0)
+                hi_s = jnp.take_along_axis(
+                    csum_b, hi_i[:, None, None], axis=1)[:, 0]
+                lo_s = jnp.take_along_axis(
+                    csum_b, lo_i[:, None, None], axis=1)[:, 0] * lo_gate
+                dmass = total_b - (hi_s - lo_s)              # [S, 3]
+                hsel = jnp.where(efb.is_valid_pos[ff][..., None], gath,
+                                 0.0)
+                hsel = jnp.where(efb.is_default_pos[ff][..., None],
+                                 dmass[:, None], hsel)       # [S, bmax, 3]
+            else:
+                hsel = jnp.take_along_axis(
+                    hist_scan, ff[:, None, None, None], axis=1)[:, 0]
+            lmask = (jnp.arange(hsel.shape[1])[None, :] <=
+                     fb_t[:, None]).astype(hsel.dtype)
+            lg_f = jnp.sum(hsel[..., 0] * lmask, axis=1)
+            lh_f = jnp.sum(hsel[..., 1] * lmask, axis=1)
+            lc_f = jnp.sum(hsel[..., 2] * lmask, axis=1)
+            pg, ph = tree.sum_grad[sn], tree.sum_hess[sn]
+            pc, pout = tree.count[sn], tree.leaf_value[sn]
+            rg_f, rh_f, rc_f = pg - lg_f, ph - lh_f, pc - lc_f
+            l1_, l2_ = hp.lambda_l1, hp.lambda_l2
+            shift = leaf_gain(pg, ph, l1_, l2_, hp.max_delta_step,
+                              hp.path_smooth, pc, pout)
+            fgain = _split_gain(lg_f, lh_f, lc_f, rg_f, rh_f, rc_f, l1_,
+                                l2_, hp, pout) - shift
+            lout_f = leaf_output(lg_f, lh_f, l1_, l2_, hp.max_delta_step,
+                                 hp.path_smooth, lc_f, pout)
+            rout_f = leaf_output(rg_f, rh_f, l1_, l2_, hp.max_delta_step,
+                                 hp.path_smooth, rc_f, pout)
+            valid_f = has_f & (lc_f > 0) & (rc_f > 0) & \
+                (forced_feat[sp] >= 0)
+            bs = bs._replace(
+                gain=jnp.where(valid_f, fgain, bs.gain),
+                feature=jnp.where(valid_f, ff, bs.feature),
+                threshold_bin=jnp.where(valid_f, fb_t, bs.threshold_bin),
+                default_left=jnp.where(valid_f, False, bs.default_left),
+                left_grad=jnp.where(valid_f, lg_f, bs.left_grad),
+                left_hess=jnp.where(valid_f, lh_f, bs.left_hess),
+                left_count=jnp.where(valid_f, lc_f, bs.left_count),
+                left_output=jnp.where(valid_f, lout_f, bs.left_output),
+                right_output=jnp.where(valid_f, rout_f, bs.right_output),
+                cat_bitset=jnp.where(valid_f[:, None], jnp.uint32(0),
+                                     bs.cat_bitset))
+            forced_ok_st = forced_ok_st.at[sn].set(valid_f) \
+                .at[m].set(False)
 
         best = BestSplits(*[
             getattr(best, fld).at[sn].set(getattr(bs, fld))
@@ -494,9 +626,17 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
         # ---- choose splits: top-budget by gain; children fit next pass
         eligible = tree.is_leaf & jnp.isfinite(best.gain) & (best.gain > 0)
+        if use_forced:
+            # forced nodes split regardless of gain sign and outrank all
+            # gain-chosen candidates (serial_tree_learner.cpp:459 BFS)
+            eligible = tree.is_leaf & jnp.isfinite(best.gain) & \
+                ((best.gain > 0) | forced_ok_st)
         if max_depth > 0:
             eligible &= tree.depth < max_depth
         gains = jnp.where(eligible[:m], best.gain[:m], -jnp.inf)
+        if use_forced:
+            gains = jnp.where(eligible[:m] & forced_ok_st[:m],
+                              1e30 + best.gain[:m], gains)
         budget = L_g - tree.num_leaves
         if k_cap is None:
             k_cap = min(k_top, s)  # children fill the next pass (2*s)
@@ -573,6 +713,22 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             gain=scat(best.gain, jnp.full(m1, -jnp.inf, jnp.float32),
                       jnp.full(m1, -jnp.inf, jnp.float32)))
 
+        if use_forced:
+            # children of an applied forced split inherit the spec's
+            # subtree; a node whose forced split was inapplicable stops
+            # forcing (the reference halts its BFS there)
+            spx = jnp.clip(node_force, 0, n_spec - 1)
+            inherit = split_mask & (node_force >= 0) & forced_ok_st
+            node_force = scat(node_force,
+                              jnp.where(inherit, forced_left[spx], -1),
+                              jnp.where(inherit, forced_right[spx], -1))
+            was_forced = was_forced | (split_mask & forced_ok_st)
+            zb_ = jnp.zeros(m1, bool)
+            forced_ok_st = scat(forced_ok_st, zb_, zb_)
+        if use_cegb and cegb_cfg.has_coupled:
+            feat_used = feat_used.at[jnp.clip(feat, 0, f - 1)].max(
+                split_mask)
+
         if hp.has_monotone:
             mcf = monotone[jnp.clip(feat, 0, f - 1)]
             mid = (best.left_output + best.right_output) * 0.5
@@ -647,7 +803,8 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         done = (k == 0) | (new_tree.num_leaves >= L_g)
         return (new_tree, row_node, tbl_c, member_c, slot_nodes, new_best,
                 cons_min, cons_max, path_mask, done, parent_hist,
-                pair_parent, pair_sleft, pair_kstart)
+                pair_parent, pair_sleft, pair_kstart, node_force,
+                forced_ok_st, feat_used, was_forced)
 
     # initial tables: nothing split, root (node 0) sits in kernel slot 0,
     # so the first sweep is an identity route + a root histogram. Pair 0
@@ -674,7 +831,8 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                        jnp.float32),                       # parent_hist
              jnp.full(P_all, -1, jnp.int32),               # pair_parent
              jnp.full(P_all, True),                        # pair_sleft
-             jnp.full(P_all, -1, jnp.int32).at[0].set(0))  # pair_kstart
+             jnp.full(P_all, -1, jnp.int32).at[0].set(0),  # pair_kstart
+             node_force0, forced_ok0, feat_used0, was_forced0)
 
     _DONE = 9  # index of the done flag in the state tuple
 
@@ -719,12 +877,17 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # trees late in boosting ran 10+ narrow fixup sweeps, decaying
     # 2.09 -> 1.70 trees/s over 95 trees).
     if over:
-        s_fix = min(128, s_max)
-        # overshoot fixups are dominated by throttled STALE pairs
-        # (2 kernel slots each); the frontier-sized kernel lets a pass
-        # commit s_fix/2 of them instead of ~s_fix/4, halving the number
-        # of full-row sweeps on exactly the late-boosting trees that
-        # decay
+        # FULL-frontier fixup capacity: the round-3 "unresolved
+        # late-tree decay" (2.69 early -> 2.3 steady) was fixup passes —
+        # late trees leave 65-200 splits past the doubling schedule, and
+        # a 128-slot fixup frontier chased them 1-3 extra full-row
+        # sweeps per tree (instrumented per-tree in-jit,
+        # helpers/instrument_decay.py, docs/PerfNotes.md round 4). At
+        # s_fix = s_max the bridge commits up to s_max/2 splits and the
+        # fixup count drops to ~0: measured flat 2.64-2.65 trees/s
+        # across 120 trees on v5e (s_fix=128: decay to 2.18; 256: 2.4).
+        # 512 caps the kernel frontier for very large num_leaves.
+        s_fix = min(int(os.environ.get("LGBM_TPU_SFIX", 512)), s_max)
         sk_fix = s_fix if hist_subtraction else None
     elif tail_split_cap <= 0:
         s_fix = min(64, s_max)
@@ -759,15 +922,19 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     tree_out = state[0]
     cmin, cmax = state[6], state[7]
     if over:
+        # forced splits outrank every gain-chosen split in the replay
+        # order (their recorded gains stay true)
+        rank = (state[0].gain + jnp.where(state[17], 1e30, 0.0)) \
+            if use_forced else None
         if quant and hp.has_monotone:
             tree_out, row_node, (cmin, cmax) = _prune_to_best_first(
                 tree_out, row_node, num_leaves=num_leaves, m_grow=m,
-                interpret=interpret,
+                interpret=interpret, rank_gain=rank,
                 aux=((cmin, -jnp.inf), (cmax, jnp.inf)))
         else:
             tree_out, row_node = _prune_to_best_first(
                 tree_out, row_node, num_leaves=num_leaves, m_grow=m,
-                interpret=interpret)
+                interpret=interpret, rank_gain=rank)
     if quant:
         # exact leaf refit: per-leaf double-bf16 sums over the final
         # row->leaf vector, psum'd under data-parallel; quantization then
@@ -794,4 +961,8 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             count=jnp.where(lf, sums[:, 2], tree_out.count))
     if debug_info:
         return tree_out, row_node, (fixup_iters, pre_prune_leaves)
+    if use_cegb:
+        # feature-used flags persist across trees (portable contract,
+        # grower.py:674); no lazy state here, flags pass through
+        return tree_out, row_node, (state[16], row_feat_used0)
     return tree_out, row_node

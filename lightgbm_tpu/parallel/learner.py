@@ -81,6 +81,7 @@ def make_sharded_grower(mesh: Mesh, comm: CommSpec, *, num_leaves: int,
             hp=hp, bmax=bmax, psum_axis=axis, interpret=interpret,
             monotone=monotone, interaction_groups=interaction_groups,
             feature_fraction_bynode=feature_fraction_bynode,
+            forced=forced, cegb_cfg=cegb_cfg, efb=efb,
             **(mxu_kwargs or {}))
     else:
         grower = functools.partial(

@@ -1,0 +1,1 @@
+"""Test-support utilities (no runtime dependencies on the main API)."""

@@ -1,0 +1,400 @@
+"""A minimal in-process stand-in for the dask/distributed surface that
+``lightgbm_tpu.dask`` consumes, so the Dask orchestration (partition
+grouping, who_has worker assignment, machines injection, rendezvous,
+rank-0 model return) EXECUTES in CI without dask installed.
+
+The reference backs its dask.py with 1,848 LoC of tests that run on real
+``distributed.LocalCluster`` workers (python-package/lightgbm/dask.py:
+68-184 and tests/python_package_test/test_dask.py). This environment has
+no dask and no package index (VERDICT r3 item 4), so this stub
+implements the narrow client API the integration touches — submit /
+run / compute / gather / who_has / scheduler_info, delayed objects,
+chunked arrays — over real SPAWNED WORKER PROCESSES (multiprocessing),
+which is exactly what the orchestration needs to be true end-to-end:
+each worker joins a genuine ``jax.distributed`` rendezvous and trains
+its own partitions. ``tests/test_dask.py`` still targets real dask for
+environments that have it.
+
+Functions cross the process boundary via cloudpickle (as in real
+distributed), so dask.py's lambdas work unmodified.
+
+Usage::
+
+    from lightgbm_tpu.testing import dask_stub
+    dask_stub.install()            # sys.modules: dask, distributed, ...
+    client = dask_stub.StubClient(n_workers=2)
+    X = dask_stub.array_from(np.ndarray, chunk_rows=500)
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import queue
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["install", "StubClient", "Array", "Delayed", "array_from",
+           "delayed", "wait", "get_client"]
+
+
+# ---------------------------------------------------------------------
+# delayed / future graph pieces
+class Delayed:
+    """A value, or a deferred fn(*args) over nested Delayed/_FutureRef."""
+
+    def __init__(self, fn=None, args=(), value=None, has_value=False):
+        self.fn = fn
+        self.args = args
+        self.value = value
+        self.has_value = has_value
+
+
+def delayed(fn):
+    def wrap(*args):
+        return Delayed(fn=fn, args=args)
+    return wrap
+
+
+class _FutureRef:
+    """Wire form of a Future: resolved from the worker's local store."""
+
+    def __init__(self, key):
+        self.key = key
+
+
+class Future:
+    def __init__(self, key: str, worker: str):
+        self.key = key
+        self.worker = worker
+        self._event = threading.Event()
+        self._value = None
+        self._error: Optional[str] = None
+
+    def _resolve(self, ok: bool, payload):
+        if ok:
+            self._value = payload
+        else:
+            self._error = payload
+        self._event.set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"future {self.key} timed out")
+        if self._error is not None:
+            raise RuntimeError(
+                f"worker task {self.key} failed:\n{self._error}")
+        return self._value
+
+
+def wait(futures):
+    for f in futures:
+        f.result()
+    return futures
+
+
+def get_client():
+    raise ValueError("no global stub client; pass client= explicitly")
+
+
+def _flatten(obj):
+    if isinstance(obj, (list, tuple)):
+        return [x for o in obj for x in _flatten(o)]
+    if isinstance(obj, dict):
+        return [x for o in obj.values() for x in _flatten(o)]
+    return [obj]
+
+
+def _strip_futures(obj):
+    """Replace Future instances with picklable _FutureRef (recursively)."""
+    if isinstance(obj, Future):
+        return _FutureRef(obj.key)
+    if isinstance(obj, Delayed):
+        return Delayed(fn=obj.fn, args=_strip_futures(obj.args),
+                       value=obj.value, has_value=obj.has_value)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_strip_futures(x) for x in obj)
+    if isinstance(obj, dict):
+        return {k: _strip_futures(v) for k, v in obj.items()}
+    return obj
+
+
+def _materialize(obj, store):
+    """Worker-side: evaluate Delayed trees and dereference futures."""
+    if isinstance(obj, _FutureRef):
+        return store[obj.key]
+    if isinstance(obj, Delayed):
+        if obj.has_value:
+            return obj.value
+        return obj.fn(*[_materialize(a, store) for a in obj.args])
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_materialize(x, store) for x in obj)
+    if isinstance(obj, dict):
+        return {k: _materialize(v, store) for k, v in obj.items()}
+    return obj
+
+
+# ---------------------------------------------------------------------
+# chunked array (the dask.array surface _concat_to_local/_delayed_parts/
+# _predict_impl touch)
+class Array:
+    def __init__(self, chunks: List[np.ndarray]):
+        self._chunks = [np.asarray(c) for c in chunks]
+
+    @property
+    def shape(self):
+        first = self._chunks[0]
+        rows = sum(c.shape[0] for c in self._chunks)
+        return (rows,) + first.shape[1:]
+
+    @property
+    def ndim(self):
+        return self._chunks[0].ndim
+
+    @property
+    def chunks(self):
+        rows = tuple(c.shape[0] for c in self._chunks)
+        first = self._chunks[0]
+        return (rows,) + tuple((d,) for d in first.shape[1:])
+
+    def to_delayed(self):
+        d = np.empty(len(self._chunks), object)
+        for i, c in enumerate(self._chunks):
+            d[i] = Delayed(value=c, has_value=True)
+        return d
+
+    def compute(self):
+        return np.concatenate(self._chunks, axis=0) \
+            if len(self._chunks) > 1 else self._chunks[0]
+
+    def map_blocks(self, fn, drop_axis=None, chunks=None, dtype=None):
+        # eager per-chunk apply — enough for the predict path
+        return Array([np.asarray(fn(c)) for c in self._chunks])
+
+
+def array_from(arr: np.ndarray, chunk_rows: int) -> Array:
+    arr = np.asarray(arr)
+    return Array([arr[i:i + chunk_rows]
+                  for i in range(0, arr.shape[0], chunk_rows)])
+
+
+class _StubDataFrame:          # isinstance targets only
+    pass
+
+
+class _StubSeries:
+    pass
+
+
+# ---------------------------------------------------------------------
+# worker process
+def _worker_main(task_q, res_q):
+    """Runs in a SPAWNED process with an untouched JAX backend, so
+    _train_part's setup_multihost can do a real jax.distributed
+    rendezvous (mesh.py:99)."""
+    import cloudpickle
+    store: Dict[str, Any] = {}
+    while True:
+        msg = task_q.get()
+        if msg is None:
+            return
+        key, blob, send_back = msg
+        try:
+            fn, args, kwargs = cloudpickle.loads(blob)
+            args = _materialize(args, store)
+            kwargs = _materialize(kwargs, store)
+            val = fn(*args, **kwargs)
+            store[key] = val
+            res_q.put((key, True, val if send_back else None))
+        except BaseException:
+            import traceback
+            res_q.put((key, False, traceback.format_exc()))
+
+
+class StubClient:
+    """distributed.Client stand-in over spawned worker processes."""
+
+    def __init__(self, n_workers: int = 2):
+        import multiprocessing
+        import socket
+        ctx = multiprocessing.get_context("spawn")
+        self._counter = itertools.count()
+        self._futures: Dict[str, Future] = {}
+        self._workers: Dict[str, tuple] = {}
+        self._rr = itertools.cycle(range(n_workers))
+        # keep worker backends small and untouched (test_multihost.py's
+        # env hygiene): CPU platform, and no site hook that would
+        # initialize the backend at interpreter start
+        patch = {"JAX_PLATFORMS": "cpu",
+                 "XLA_FLAGS": "--xla_force_host_platform_device_count=2"}
+        saved = {k: os.environ.get(k) for k in
+                 list(patch) + ["PALLAS_AXON_POOL_IPS",
+                                "LIGHTGBM_TPU_MACHINE_RANK"]}
+        os.environ.update(patch)
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ.pop("LIGHTGBM_TPU_MACHINE_RANK", None)
+        try:
+            for _ in range(n_workers):
+                s = socket.socket()
+                s.bind(("127.0.0.1", 0))
+                addr = "tcp://127.0.0.1:%d" % s.getsockname()[1]
+                s.close()
+                tq, rq = ctx.Queue(), ctx.Queue()
+                proc = ctx.Process(target=_worker_main, args=(tq, rq),
+                                   daemon=True)
+                proc.start()
+                drain = threading.Thread(target=self._drain,
+                                         args=(rq, addr, proc),
+                                         daemon=True)
+                drain.start()
+                self._workers[addr] = (proc, tq, rq, drain)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    # -- client API used by lightgbm_tpu.dask -------------------------
+    def scheduler_info(self):
+        return {"workers": {w: {} for w in self._workers}}
+
+    def submit(self, fn, *args, workers=None, pure=False, **kwargs):
+        import cloudpickle
+        addrs = sorted(self._workers)
+        if workers:
+            w = workers[0]
+        else:
+            # locality: run where an argument future's value lives (the
+            # real scheduler's data-locality placement)
+            arg_futs = [a for a in _flatten(args) + _flatten(kwargs)
+                        if isinstance(a, Future)]
+            w = arg_futs[0].worker if arg_futs else \
+                addrs[next(self._rr) % len(addrs)]
+        key = f"task-{next(self._counter)}"
+        fut = Future(key, w)
+        self._futures[key] = fut
+        blob = cloudpickle.dumps(
+            (fn, _strip_futures(args), _strip_futures(kwargs)))
+        self._workers[w][1].put((key, blob, True))
+        return fut
+
+    def compute(self, delayeds):
+        # schedule partition tuples round-robin; values stay worker-side
+        import cloudpickle
+        addrs = sorted(self._workers)
+        futs = []
+        for d in delayeds:
+            w = addrs[next(self._rr) % len(addrs)]
+            key = f"task-{next(self._counter)}"
+            fut = Future(key, w)
+            self._futures[key] = fut
+            blob = cloudpickle.dumps(
+                (_materialize, (_strip_futures(d), {}), {}))
+            self._workers[w][1].put((key, blob, False))
+            futs.append(fut)
+        return futs
+
+    def who_has(self, futures):
+        wait(futures)
+        return {f.key: [f.worker] for f in futures}
+
+    def run(self, fn, workers=None):
+        targets = workers if workers is not None else sorted(self._workers)
+        futs = {w: self.submit(fn, workers=[w]) for w in targets}
+        return {w: f.result() for w, f in futs.items()}
+
+    def gather(self, futures):
+        return [f.result() for f in futures]
+
+    def close(self):
+        for proc, tq, _rq, _d in self._workers.values():
+            tq.put(None)
+        for proc, _tq, _rq, _d in self._workers.values():
+            proc.join(timeout=10)
+            if proc.is_alive():
+                proc.terminate()
+
+    def _drain(self, rq, addr, proc):
+        while True:
+            try:
+                key, ok, payload = rq.get(timeout=1.0)
+            except queue.Empty:
+                if not proc.is_alive():
+                    # a dead worker (segfault, hard exit) must FAIL its
+                    # pending futures, not hang result() forever
+                    for f in list(self._futures.values()):
+                        if f.worker == addr and not f._event.is_set():
+                            f._resolve(False,
+                                       f"worker process {addr} died "
+                                       f"(exitcode {proc.exitcode})")
+                    return
+                continue
+            except (EOFError, OSError):
+                return
+            fut = self._futures.get(key)
+            if fut is not None:
+                fut._resolve(ok, payload)
+
+
+# ---------------------------------------------------------------------
+_SAVED_MODULES: Optional[Dict[str, Any]] = None
+_STUB_NAMES = ("dask", "dask.array", "dask.dataframe", "distributed")
+
+
+def uninstall():
+    """Undo install(): restore the real dask/distributed modules (or
+    their absence) and re-resolve lightgbm_tpu.dask against them, so
+    stub-based tests don't leak into real-dask tests that run later."""
+    global _SAVED_MODULES
+    import importlib
+    import sys
+    if _SAVED_MODULES is None:
+        return
+    for name in _STUB_NAMES:
+        if _SAVED_MODULES[name] is None:
+            sys.modules.pop(name, None)
+        else:
+            sys.modules[name] = _SAVED_MODULES[name]
+    _SAVED_MODULES = None
+    import lightgbm_tpu.dask as lgb_dask
+    importlib.reload(lgb_dask)
+
+
+def install():
+    """Register stub modules so ``import dask.array`` /
+    ``from distributed import wait`` inside lightgbm_tpu.dask resolve to
+    this stub. Reloads lightgbm_tpu.dask if it was imported without
+    dask. Returns the (reloaded) lightgbm_tpu.dask module; call
+    uninstall() to restore the previous module state."""
+    global _SAVED_MODULES
+    import importlib
+    import sys
+    import types
+
+    if _SAVED_MODULES is None:
+        _SAVED_MODULES = {name: sys.modules.get(name)
+                          for name in _STUB_NAMES}
+    dask_mod = types.ModuleType("dask")
+    dask_mod.delayed = delayed
+    array_mod = types.ModuleType("dask.array")
+    array_mod.Array = Array
+    array_mod.from_array = array_from
+    df_mod = types.ModuleType("dask.dataframe")
+    df_mod.DataFrame = _StubDataFrame
+    df_mod.Series = _StubSeries
+    dask_mod.array = array_mod
+    dask_mod.dataframe = df_mod
+    dist_mod = types.ModuleType("distributed")
+    dist_mod.wait = wait
+    dist_mod.get_client = get_client
+    dist_mod.Client = StubClient
+    sys.modules["dask"] = dask_mod
+    sys.modules["dask.array"] = array_mod
+    sys.modules["dask.dataframe"] = df_mod
+    sys.modules["distributed"] = dist_mod
+
+    import lightgbm_tpu.dask as lgb_dask
+    return importlib.reload(lgb_dask)

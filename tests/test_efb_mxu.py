@@ -19,7 +19,8 @@ from lightgbm_tpu.learner.grower_mxu import grow_tree_mxu
 from lightgbm_tpu.learner.split import SplitHyperParams
 
 
-def _sparse_ds(n=4000, f=24, seed=0, with_nan=False, with_cat=False):
+def _sparse_ds(n=4000, f=24, seed=0, with_nan=False, with_cat=False,
+               seg=False):
     rng = np.random.RandomState(seed)
     X = np.zeros((n, f))
     for g in range(0, f, 8):
@@ -38,7 +39,13 @@ def _sparse_ds(n=4000, f=24, seed=0, with_nan=False, with_cat=False):
     plan = build_plan(np.asarray(ds.bins), ds.num_bins, ds.default_bins,
                       np.asarray(ds.is_categorical), max_bundle_bins=256)
     assert plan is not None and plan.effective
-    efb = make_device_tables(plan, ds.default_bins)
+    # seg=True attaches the segmented-scan tables (split_bundled.py);
+    # the MXU grower then scans bundle space directly
+    efb = make_device_tables(
+        plan, ds.default_bins,
+        num_bins=ds.num_bins if seg else None,
+        missing_is_nan=(ds.missing_types == 2) if seg else None,
+        is_cat=np.asarray(ds.is_categorical) if seg else None)
     bund = jnp.asarray(bundle_matrix(np.asarray(ds.bins), plan))
     p = np.full(n, 0.5, np.float32)
     return ds, efb, bund, jnp.asarray(p - y), jnp.asarray(p * (1 - p))
@@ -76,16 +83,19 @@ def _assert_same_tree(t_ref, r_ref, t_mxu, r_mxu):
 
 
 class TestEfbMXU:
-    def test_matches_scatter_efb(self):
-        ds, efb, bund, g, h = _sparse_ds()
+    @pytest.mark.parametrize("seg", [False, True])
+    def test_matches_scatter_efb(self, seg):
+        ds, efb, bund, g, h = _sparse_ds(seg=seg)
         _assert_same_tree(*_grow_both(ds, efb, bund, g, h))
 
-    def test_matches_with_nan(self):
-        ds, efb, bund, g, h = _sparse_ds(seed=1, with_nan=True)
+    @pytest.mark.parametrize("seg", [False, True])
+    def test_matches_with_nan(self, seg):
+        ds, efb, bund, g, h = _sparse_ds(seed=1, with_nan=True, seg=seg)
         _assert_same_tree(*_grow_both(ds, efb, bund, g, h))
 
-    def test_matches_with_categorical(self):
-        ds, efb, bund, g, h = _sparse_ds(seed=2, with_cat=True)
+    @pytest.mark.parametrize("seg", [False, True])
+    def test_matches_with_categorical(self, seg):
+        ds, efb, bund, g, h = _sparse_ds(seed=2, with_cat=True, seg=seg)
         _assert_same_tree(*_grow_both(ds, efb, bund, g, h))
 
     def test_overgrow_prune_with_efb(self):
@@ -113,6 +123,63 @@ class TestEfbMXU:
         vals_rows = np.asarray(t.leaf_value)[np.asarray(r)]
         np.testing.assert_allclose(np.asarray(vals_route), vals_rows,
                                    rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("with_nan,with_cat", [(False, False),
+                                                   (True, False),
+                                                   (True, True)])
+    def test_segmented_scan_matches_expansion(self, with_nan, with_cat):
+        # scan-level differential: find_best_splits_bundled on [S,Fb,Bb]
+        # must pick the same split as expand_histograms +
+        # find_best_splits on [S,F,Bmax], per slot, on histograms built
+        # from real routed rows
+        import jax
+        from lightgbm_tpu.efb import expand_histograms
+        from lightgbm_tpu.learner.split import find_best_splits
+        from lightgbm_tpu.learner.split_bundled import \
+            find_best_splits_bundled
+        ds, efb, bund, g, h = _sparse_ds(seed=7, with_nan=with_nan,
+                                         with_cat=with_cat, seg=True)
+        n = ds.num_data
+        s = 4
+        rng = np.random.RandomState(3)
+        row_node = jnp.asarray(rng.randint(0, s, n))
+        fb, bb = efb.num_cols, efb.bundle_bmax
+        onehot_s = jax.nn.one_hot(row_node, s, dtype=jnp.float32)
+        onehot_b = jax.nn.one_hot(np.asarray(bund), bb, dtype=jnp.float32)
+        stats = jnp.stack([g, h, jnp.ones(n, jnp.float32)], -1)
+        hist_b = jnp.einsum("ns,nfb,nc->sfbc", onehot_s, onehot_b, stats)
+        pg = jnp.einsum("ns,n->s", onehot_s, g)
+        ph = jnp.einsum("ns,n->s", onehot_s, h)
+        pc = jnp.sum(onehot_s, axis=0)
+        po = jnp.zeros(s)
+        nb = jnp.asarray(ds.num_bins)
+        mn = jnp.asarray(ds.missing_types == 2)
+        ic = jnp.asarray(ds.is_categorical)
+        fm = jnp.ones(ds.num_features, jnp.float32)
+        hp = SplitHyperParams(
+            min_data_in_leaf=5,
+            has_categorical=bool(np.any(ds.is_categorical)))
+        bs_seg = find_best_splits_bundled(hist_b, pg, ph, pc, po, nb, mn,
+                                          ic, fm, hp, efb)
+        bs_exp = find_best_splits(expand_histograms(hist_b, efb), pg, ph,
+                                  pc, po, nb, mn, ic, fm, hp)
+        np.testing.assert_array_equal(np.asarray(bs_seg.feature),
+                                      np.asarray(bs_exp.feature))
+        np.testing.assert_array_equal(np.asarray(bs_seg.threshold_bin),
+                                      np.asarray(bs_exp.threshold_bin))
+        np.testing.assert_array_equal(np.asarray(bs_seg.default_left),
+                                      np.asarray(bs_exp.default_left))
+        np.testing.assert_allclose(np.asarray(bs_seg.gain),
+                                   np.asarray(bs_exp.gain),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(bs_seg.left_count),
+                                   np.asarray(bs_exp.left_count),
+                                   rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(bs_seg.left_output),
+                                   np.asarray(bs_exp.left_output),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(bs_seg.cat_bitset),
+                                      np.asarray(bs_exp.cat_bitset))
 
     def test_quantized_with_efb(self):
         ds, efb, bund, g, h = _sparse_ds(seed=4)

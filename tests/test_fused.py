@@ -70,6 +70,64 @@ class TestFusedScan:
             np.asarray(a.gbdt.train_score), np.asarray(b.gbdt.train_score))
         assert a.model_to_string() == b.model_to_string()
 
+    @pytest.mark.parametrize("extra_params", [
+        {"bagging_fraction": 0.7, "bagging_freq": 2},          # bagging
+        {"boosting": "goss", "top_rate": 0.3, "other_rate": 0.3},  # GOSS
+    ])
+    def test_fused_sampling_equals_per_iteration(self, extra_params):
+        # round-4 eligibility ring: bagging recomputed statelessly
+        # in-scan; GOSS rides pre-drawn keys (gbdt._fused_sample_fn)
+        X, y = _data(seed=6)
+        boosters = []
+        for _ in range(2):
+            ds = lgb.Dataset(X, label=y, params={"max_bin": 31})
+            bst = lgb.Booster(params={**PARAMS, **extra_params},
+                              train_set=ds)
+            bst.update()
+            g = bst.gbdt
+            g._hist_impl = "mxu"
+            g._mxu_interpret = True
+            g._fused_run = None
+            boosters.append(bst)
+        a, b = boosters
+        assert a.gbdt._fused_eligible()
+        a.update_batch(3)
+        for _ in range(3):
+            b.update()
+        assert a.current_iteration() == b.current_iteration() == 4
+        np.testing.assert_array_equal(
+            np.asarray(a.gbdt.train_score), np.asarray(b.gbdt.train_score))
+        assert a.model_to_string() == b.model_to_string()
+
+    def test_fused_multiclass_equals_per_iteration(self):
+        rng = np.random.RandomState(8)
+        X = rng.randn(600, 5).astype(np.float32)
+        y = (X[:, 0] + 0.3 * rng.randn(600) > 0).astype(np.float32) + \
+            (X[:, 1] > 0.5).astype(np.float32)
+        boosters = []
+        for _ in range(2):
+            ds = lgb.Dataset(X, label=y, params={"max_bin": 31})
+            bst = lgb.Booster(
+                params={**PARAMS, "objective": "multiclass",
+                        "num_class": 3}, train_set=ds)
+            bst.update()
+            g = bst.gbdt
+            g._hist_impl = "mxu"
+            g._mxu_interpret = True
+            g._fused_run = None
+            boosters.append(bst)
+        a, b = boosters
+        assert a.gbdt._fused_eligible()
+        a.update_batch(3)
+        for _ in range(3):
+            b.update()
+        assert a.current_iteration() == b.current_iteration() == 4
+        assert len(a.gbdt.trees) == len(b.gbdt.trees) == 12
+        assert a.gbdt.tree_class == b.gbdt.tree_class
+        np.testing.assert_array_equal(
+            np.asarray(a.gbdt.train_score), np.asarray(b.gbdt.train_score))
+        assert a.model_to_string() == b.model_to_string()
+
     def test_scan_of_k_equals_k_scans(self):
         X, y = _data(seed=3)
         a = self._mxu_booster(X, y)

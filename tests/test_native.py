@@ -139,3 +139,101 @@ class TestFileIO:
         from lightgbm_tpu.utils.file_io import open_file
         with pytest.raises(ValueError, match="no filesystem registered"):
             open_file("nosuchscheme://x/y", "r")
+
+
+class TestNativeBoundarySearch:
+    """lgbt_find_numeric_bounds must be mapper-identical to the NumPy
+    from_sample path (cext/binning.cpp; reference dataset_loader.cpp
+    OMP FindBin loop)."""
+
+    def _compare(self, X, max_bin=63, use_missing=True,
+                 zero_as_missing=False):
+        from lightgbm_tpu import cext
+        from lightgbm_tpu.binning import (BinMapper, _ZERO_THRESHOLD)
+        if not cext.available():
+            import pytest
+            pytest.skip("no native toolchain")
+        sample_t = np.ascontiguousarray(X.T, np.float64)
+        blist, mtype, minmax, zero_na = cext.find_numeric_bounds(
+            sample_t, max_bin, 3, use_missing, zero_as_missing)
+        for f in range(X.shape[1]):
+            col = sample_t[f]
+            nonzero = col[(np.abs(col) > _ZERO_THRESHOLD) | np.isnan(col)]
+            ref = BinMapper.from_sample(
+                nonzero, X.shape[0], max_bin, 3, use_missing,
+                zero_as_missing)
+            nat = BinMapper._from_native(
+                blist[f], mtype[f], minmax[f], zero_na[f], X.shape[0])
+            assert nat.num_bin == ref.num_bin, f
+            assert nat.missing_type == ref.missing_type, f
+            assert nat.default_bin == ref.default_bin, f
+            assert nat.is_trivial == ref.is_trivial, f
+            np.testing.assert_allclose(nat.bin_upper_bound,
+                                       ref.bin_upper_bound, rtol=0,
+                                       atol=0, err_msg=str(f))
+            assert nat.min_val == ref.min_val
+            assert nat.max_val == ref.max_val
+            assert nat.sparse_rate == ref.sparse_rate
+
+    def test_dense_gaussian(self):
+        r = np.random.RandomState(0)
+        self._compare(r.randn(5000, 8).astype(np.float32))
+
+    def test_sparse_with_nan(self):
+        r = np.random.RandomState(1)
+        X = np.zeros((4000, 6))
+        mask = r.rand(4000, 6) < 0.1
+        X[mask] = r.randn(int(mask.sum())) + 1.0
+        X[r.rand(4000, 6) < 0.03] = np.nan
+        self._compare(X)
+
+    def test_few_distinct_and_constant(self):
+        r = np.random.RandomState(2)
+        X = np.stack([
+            r.randint(0, 4, 3000).astype(np.float64),
+            np.full(3000, 2.5),
+            np.zeros(3000),
+            np.where(r.rand(3000) < 0.5, -1.25, 3.75),
+        ], axis=1)
+        self._compare(X, max_bin=255)
+
+    def test_zero_as_missing(self):
+        r = np.random.RandomState(3)
+        X = np.zeros((3000, 4))
+        m = r.rand(3000, 4) < 0.4
+        X[m] = r.randn(int(m.sum()))
+        self._compare(X, zero_as_missing=True)
+
+    def test_negative_heavy(self):
+        r = np.random.RandomState(4)
+        self._compare(-np.abs(r.randn(4000, 5)) - 0.5, max_bin=31)
+
+    def test_find_bin_mappers_dispatch_equal(self):
+        # end-to-end: find_bin_mappers (native fast path) equals the
+        # pure-python construction, including a categorical column
+        from lightgbm_tpu import binning, cext
+        if not cext.available():
+            import pytest
+            pytest.skip("no native toolchain")
+        r = np.random.RandomState(5)
+        X = r.randn(3000, 5)
+        X[:, 2] = r.randint(0, 7, 3000)
+        X[r.rand(3000) < 0.05, 0] = np.nan
+        fast = binning.find_bin_mappers(X, max_bin=63,
+                                        categorical_features=[2])
+        sample_t = np.ascontiguousarray(X.T, np.float64)
+        slow = []
+        for f in range(5):
+            col = sample_t[f]
+            nz = col[(np.abs(col) > binning._ZERO_THRESHOLD) |
+                     np.isnan(col)]
+            slow.append(binning.BinMapper.from_sample(
+                nz, 3000, 63, 3, True, False, is_categorical=f == 2))
+        for f, (a, b) in enumerate(zip(fast, slow)):
+            assert a.num_bin == b.num_bin, f
+            assert a.missing_type == b.missing_type, f
+            assert a.default_bin == b.default_bin, f
+            np.testing.assert_array_equal(
+                np.asarray(a.bin_upper_bound),
+                np.asarray(b.bin_upper_bound), err_msg=str(f))
+            assert a.bin_2_categorical == b.bin_2_categorical, f
