@@ -88,12 +88,17 @@ def _probe_with_retry() -> str:
 
 PARAMS = {"objective": "binary", "num_leaves": NUM_LEAVES,
           "learning_rate": 0.1, "max_bin": MAX_BIN, "verbosity": -1,
-          "min_data_in_leaf": 20, "use_quantized_grad": True}
-# use_quantized_grad: stochastically-rounded integer gradients with
-# exact leaf refit. A/B at this config (docs/PerfNotes.md round 3):
-# 2.31 vs 1.74 trees/s, AUC@95 0.98119 (quant) vs 0.98092 (exact) —
-# the quantization effect (~2.4e-4) is an order of magnitude below
-# growth-order noise, and the held-out AUC is printed either way
+          "min_data_in_leaf": 20, "use_quantized_grad": True,
+          "growth_overshoot": 1.75}
+# Bench posture vs library defaults (both A/B'd, docs/PerfNotes.md):
+# - use_quantized_grad: stochastically-rounded integer gradients with
+#   exact leaf refit. Round-3 A/B: 2.31 vs 1.74 trees/s, AUC@95
+#   0.98119 (quant) vs 0.98092 (exact) — ~2.4e-4, an order below
+#   growth-order noise.
+# - growth_overshoot 1.75 (default 2.0): round-4 A/B at 105 trees:
+#   1.75 -> 2.83-3.4 t/s AUC 0.98098; 2.0 -> 2.68 t/s AUC 0.98129
+#   (~3e-4, same order as quantization). 1.5 costs 1.1e-3 — rejected.
+# The held-out AUC is printed below either way.
 
 
 def _drain(booster):
@@ -156,8 +161,9 @@ class _Bench:
                 self.booster.update_batch(n_trees)
                 _drain(self.booster)
                 dt = time.time() - t1
-                clean = getattr(self.booster.gbdt, "_fused_failures",
-                                0) <= ff0
+                gb = self.booster.gbdt
+                clean = (getattr(gb, "_fused_failures", 0) <= ff0 and
+                         not getattr(gb, "_fused_disabled", False))
                 return dt, clean
             except Exception as exc:
                 print(f"# block failed (attempt {attempt}): "

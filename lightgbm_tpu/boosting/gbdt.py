@@ -225,10 +225,16 @@ class GBDT:
             else:
                 self._hist_impl = "pallas" if self._efb is None \
                     else "scatter"
-                Log.warning(
-                    "training runs on the portable %s grower (MXU path "
-                    "excluded by: %s) — expect ~10x lower throughput on "
-                    "TPU", self._hist_impl, ", ".join(excl))
+                # the EFB exclusion is the MEASURED-best default (the
+                # portable grower wins on bundled data, PerfNotes r4)
+                # — only the genuine perf cliffs warn
+                hard = [r for r in excl if r != "efb config"]
+                if hard:
+                    Log.warning(
+                        "training runs on the portable %s grower (MXU "
+                        "path excluded by: %s) — expect ~10x lower "
+                        "throughput on TPU", self._hist_impl,
+                        ", ".join(hard))
         else:
             self._hist_impl = "scatter"
         Log.debug("Tree kernel path: %s (backend=%s)", self._hist_impl,
@@ -438,12 +444,13 @@ class GBDT:
         excl = self._mxu_exclusions(cfg)
         use_mxu = (cfg.use_pallas and jax.default_backend() != "cpu" and
                    self.comm.mode == "data" and not excl)
-        if excl and cfg.use_pallas and jax.default_backend() != "cpu" \
+        hard = [r for r in excl if r != "efb config"]
+        if hard and cfg.use_pallas and jax.default_backend() != "cpu" \
                 and self.comm.mode == "data":
             Log.warning(
                 "data-parallel training runs on the portable grower "
                 "inside shard_map (MXU path excluded by: %s) — expect "
-                "~10x lower throughput on TPU", ", ".join(excl))
+                "~10x lower throughput on TPU", ", ".join(hard))
         self._sharded_mxu = use_mxu
         # per-node sampling / extra_trees / quantized rounding need a
         # per-iteration key; it rides into shard_map replicated so every

@@ -257,13 +257,16 @@ _PARAMS: List[ParamSpec] = [
        "many splits per pass before re-ranking (approaches the "
        "reference's strict best-first order, serial_tree_learner.cpp:159, "
        "as the cap shrinks). 0 = unthrottled batched growth"),
-    _p("efb_use_mxu", bool, True, (),
+    _p("efb_use_mxu", bool, False, (),
        desc="route EFB-bundled training through the MXU growth path: "
-            "bundle-space histogram kernels + the segmented bundle-space "
-            "split scan (split_bundled.py — the reference's sub-feature "
-            "offset scan, feature_histogram.hpp over feature_group.h "
-            "ranges). false falls back to the portable scatter grower "
-            "for bundled data"),
+            "bundle-space histogram kernels, the segmented bundle-space "
+            "split scan (split_bundled.py), and bundle-range routing. "
+            "Parity-tested, but the portable scatter grower measured "
+            "FASTER on every bundled shape tried (docs/PerfNotes.md "
+            "round 4: bundling is exactly the transformation that makes "
+            "scatter updates cheap, while the one-hot-matmul histogram "
+            "still pays per padded lane) — so bundled data defaults to "
+            "the portable grower"),
     _p("efb_segmented_scan", bool, True, (),
        desc="scan bundled histograms directly per sub-feature segment "
             "([S, Fb, Bb] stays bundle-sized; split_bundled.py). false "

@@ -215,6 +215,16 @@ class EfbScan(NamedTuple):
     #                                 (-1: NaN bin IS the default bin)
     has_nan_pos: object             # [Fb, Bb] bool feature has NaN bin
     cat_feats: object               # [Fc] i32 categorical feature ids
+    # ---- bundle-RANGE routing tables (histogram_mxu._route_decide's
+    # efb_range mode): a numeric split (f, t) becomes pure position
+    # compares on the row's bundle bin — in-segment rows go left iff
+    # pos <= pos_thresh[f, t], out-of-segment rows (the feature sits at
+    # its default bin) go by db_left, the NaN position goes by
+    # default_left. No per-row original-bin decode at all.
+    pos_thresh: object              # [F, bmax] i32 last left pos per t
+    db_le_t: object                 # [F, bmax] bool default bin <= t
+    nan_is_default: object          # [F] bool NaN bin IS the default
+    p_nan_f: object                 # [F] i32 NaN-bin position (-1 none)
 
 
 class EfbDev(NamedTuple):
@@ -261,11 +271,27 @@ def _make_scan_tables(plan: EfbPlan, default_bins: np.ndarray,
     nan_flat = np.full((fb, bb), -1, np.int32)
     has_nan_p = np.zeros((fb, bb), bool)
     f = plan.col_of_feat.shape[0]
+    bmax = plan.pos_of_local.shape[1]
+    pos_thresh = np.zeros((f, bmax), np.int32)
+    db_le_t = np.zeros((f, bmax), bool)
+    nan_is_def = np.zeros(f, bool)
+    p_nan_arr = np.full(f, -1, np.int32)
     for fi in range(f):
         g = int(plan.col_of_feat[fi])
         nb = int(num_bins[fi])
         db = int(default_bins[fi])
         nan = bool(missing_is_nan[fi])
+        # range-routing tables: last left-side position per threshold
+        pp = int(plan.seg_lo[fi]) - 1
+        for t in range(bmax):
+            if t < nb and plan.pos_of_local[fi, t] >= 0:
+                pp = int(plan.pos_of_local[fi, t])
+            pos_thresh[fi, t] = pp
+            db_le_t[fi, t] = db <= t
+        if nan:
+            pn = int(plan.pos_of_local[fi, nb - 1])
+            p_nan_arr[fi] = pn
+            nan_is_def[fi] = pn < 0
         # every position of fi gets its feature id + segment/nan info
         pos_list = [int(plan.pos_of_local[fi, b]) for b in range(nb)
                     if plan.pos_of_local[fi, b] >= 0]
@@ -310,7 +336,11 @@ def _make_scan_tables(plan: EfbPlan, default_bins: np.ndarray,
         is_multi_pos=jnp.asarray(is_multi_p),
         nan_flat=jnp.asarray(nan_flat),
         has_nan_pos=jnp.asarray(has_nan_p),
-        cat_feats=jnp.asarray(cat_feats))
+        cat_feats=jnp.asarray(cat_feats),
+        pos_thresh=jnp.asarray(pos_thresh),
+        db_le_t=jnp.asarray(db_le_t),
+        nan_is_default=jnp.asarray(nan_is_def),
+        p_nan_f=jnp.asarray(p_nan_arr))
 
 
 def make_device_tables(plan: EfbPlan, default_bins: np.ndarray,

@@ -242,6 +242,9 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     fk = bins.shape[1] if efb is not None else f
     bk = efb.bundle_bmax if efb is not None else bmax
     loc_tbl = efb.loc_table if efb is not None else None
+    # segmented EFB routes by bundle-position RANGES packed into the
+    # node tables (histogram_mxu efb_range) — no per-row decode
+    efb_seg = efb is not None and efb.scan is not None
     # overshoot > 1 switches to overgrow-and-prune: grow toward
     # overshoot*num_leaves leaves with unthrottled batched passes, then
     # replay the exact best-first selection over the recorded gains
@@ -375,26 +378,31 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             tbl_c = tbl_c[:m_cap]
             member_c = member_c[:m_cap]
         # measured on v5e: small frontiers run ~15% cheaper at half
-        # blocks, large ones prefer the wider block; the EFB route side
-        # (original-feature one-hots + loc decode) needs the small block
-        # to stay inside VMEM at wide F
-        rb = 1024 if efb is not None else \
-            (int(os.environ.get("LGBM_TPU_RB_SMALL", 2048))
-             if nslots <= 64 else 4096)
+        # blocks, large ones prefer the wider block. EFB keeps rb=1024
+        # in BOTH modes: expansion's original-feature route side needs
+        # the VMEM headroom (a 2048 block compiled to a real 136 MB
+        # OOM at 250-column bundles), and for bundle-range mode larger
+        # adaptive blocks measured WORSE (0.059 vs 0.182 trees/s on the
+        # low-cardinality shape, docs/PerfNotes.md round 4)
+        rw = f if (efb is not None and not efb_seg) else 0
+        rb = (1024 if efb is not None else
+              (int(os.environ.get("LGBM_TPU_RB_SMALL", 2048))
+               if nslots <= 64 else 4096))
         if fits_v2(nslots, fk, bk, hist_double_prec, quant,
-                   route_width=f if efb is not None else 0,
-                   row_block=rb):
+                   route_width=rw, row_block=rb):
             h, rn = fused_route_hist_mxu(
                 bins, h_grad, h_hess, cnt_weight, row_node, tbl_c,
                 member_c, feat_tbl, num_slots=nslots, bmax=bk,
                 has_cat=hp.has_categorical, quantized=quant,
                 double_prec=hist_double_prec, num_features=nf_packed,
-                loc_table=loc_tbl, row_block=rb,
+                loc_table=None if efb_seg else loc_tbl,
+                efb_range=efb_seg, row_block=rb,
                 interpret=interpret)
         else:
             rn, rs = route_rows_mxu(bins, row_node, tbl_c, member_c,
                                     feat_tbl, num_features=nf_packed,
-                                    loc_table=loc_tbl,
+                                    loc_table=None if efb_seg
+                                    else loc_tbl, efb_range=efb_seg,
                                     interpret=interpret)
             h = build_histograms_mxu_auto(
                 bins, h_grad, h_hess, cnt_weight, rs, num_slots=nslots,
@@ -798,7 +806,8 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             split_mask, fclip, best.threshold_bin,
             best.default_left, new_tree.is_cat, child_l, child_r,
             slot_of_node, new_tree.cat_bitset, m_pad, bmax,
-            bcol=efb.col_of_feat[fclip] if efb is not None else None)
+            bcol=efb.col_of_feat[fclip] if efb is not None else None,
+            efb=efb)
 
         done = (k == 0) | (new_tree.num_leaves >= L_g)
         return (new_tree, row_node, tbl_c, member_c, slot_nodes, new_best,
@@ -816,7 +825,7 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         jnp.zeros(m1, bool), jnp.full(m1, m, jnp.int32),
         jnp.full(m1, m, jnp.int32),
         jnp.full(m1, -1, jnp.int32).at[0].set(0),
-        jnp.zeros((m1, w_cat), jnp.uint32), m_pad, bmax)
+        jnp.zeros((m1, w_cat), jnp.uint32), m_pad, bmax, efb=efb)
     state = (tree0,
              jnp.zeros(n, jnp.int32),                     # row_node
              tbl0, member0,                               # route tables
@@ -918,7 +927,8 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # START of a pass, so the final commits have not moved rows yet)
     row_node, _ = route_rows_mxu(bins, state[1], state[2], state[3],
                                  feat_tbl, num_features=nf_packed,
-                                 loc_table=loc_tbl, interpret=interpret)
+                                 loc_table=None if efb_seg else loc_tbl,
+                                 efb_range=efb_seg, interpret=interpret)
     tree_out = state[0]
     cmin, cmax = state[6], state[7]
     if over:

@@ -263,7 +263,7 @@ def _hist_accumulate(hist_ref, slot, bins_i, data, *, nb: int, f: int,
 
 
 def _route_decide(node, gath, bins_blk, ftbl, memb, *, nb: int,
-                  fh: int = 0, loc=None):
+                  fh: int = 0, loc=None, efb_range: bool = False):
     """Shared split-decision math of the route/fused kernels: numerical
     thresholds, NaN-bin default direction, categorical bitset membership.
     gath: [nb, K] node-table row per row; bins_blk: [nb, lanes] f32
@@ -292,56 +292,84 @@ def _route_decide(node, gath, bins_blk, ftbl, memb, *, nb: int,
     child_l = col(_COL_LEFT_Q) * 256.0 + col(_COL_LEFT_R)
     child_r = col(_COL_RIGHT_Q) * 256.0 + col(_COL_RIGHT_R)
 
-    if fh:
-        # packed storage: pick the byte column pf % fh, then the nibble
-        fh_f = jnp.float32(fh)
-        is_hi = jnp.where(pf >= fh_f, jnp.float32(1.0), jnp.float32(0.0))
-        pcol = pf - is_hi * fh_f
-        iota_p = jax.lax.broadcasted_iota(
-            jnp.int32, (nb, bins_blk.shape[1]), 1).astype(jnp.float32)
-        pbyte = jnp.sum(jnp.where(pcol == iota_p, bins_blk, 0.0),
-                        axis=1, keepdims=True)               # [nb, 1] f32
-        hi_val = jnp.floor(pbyte * jnp.float32(1.0 / 16.0))
-        binv = is_hi * hi_val + (1.0 - is_hi) * (pbyte - 16.0 * hi_val)
-    # per-feature flags (num_bins, missing_is_nan) index the full-width
-    # feature table regardless of bin packing/bundling
-    iota_f = jax.lax.broadcasted_iota(
-        jnp.int32, (nb, ftbl.shape[0]), 1).astype(jnp.float32)
-    feat_oh = (pf == iota_f)                                 # [nb, L] bool
-    if loc is not None:
-        # EFB: bundle-column select, then original-local-bin decode
+    # predicates as 0/1 f32 (Mosaic lacks i1-valued selects)
+    one = jnp.float32(1.0)
+    zero = jnp.float32(0.0)
+    defl_f = jnp.where(defl, one, zero)
+    if efb_range:
+        # EFB bundle-RANGE decision: the row's bundle bin compared to
+        # per-node position constants (pack_route_tables efb columns).
+        # In-segment rows go left iff pos <= P(t); the NaN position goes
+        # by default_left; out-of-segment rows (the split feature sits
+        # at its default bin) go by the precomputed default side. No
+        # original-bin decode, no [rows, F]-wide work — identity columns
+        # (dense numerics, categoricals) reduce to the plain bin compare
+        # because their segment spans the whole column.
         bcol = col(_COL_BCOL_Q) * 256.0 + col(_COL_BCOL_R)
         iota_c = jax.lax.broadcasted_iota(
             jnp.int32, (nb, bins_blk.shape[1]), 1).astype(jnp.float32)
         pval = jnp.sum(jnp.where(bcol == iota_c, bins_blk, 0.0),
                        axis=1, keepdims=True)                # [nb, 1] f32
-        # loc row of the split feature: one MXU dot (entries <= 256,
-        # bf16-exact; 0/1 lhs keeps the f32 accumulation a selection)
-        loc_row = jax.lax.dot_general(
-            feat_oh.astype(jnp.bfloat16), loc.astype(jnp.bfloat16),
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)              # [nb, Bb]
-        iota_b2 = jax.lax.broadcasted_iota(
-            jnp.int32, (nb, loc.shape[1]), 1).astype(jnp.float32)
-        binv = jnp.sum(jnp.where(pval == iota_b2, loc_row, 0.0),
-                       axis=1, keepdims=True)                # [nb, 1] f32
-    elif not fh:
-        # column select: binv[r] = bins[r, pf[r]] via one-hot mask-sum
-        binv = jnp.sum(jnp.where(feat_oh, bins_blk, 0.0), axis=1,
-                       keepdims=True)                        # [nb, 1] f32
-    nbins = jnp.sum(jnp.where(feat_oh, ftbl[:, 0][None, :], 0.0),
-                    axis=1, keepdims=True)
-    mnan = jnp.sum(jnp.where(feat_oh, ftbl[:, 1][None, :], 0.0),
-                   axis=1, keepdims=True) > 0.5
-    is_nan_bin = mnan & (binv == nbins - 1.0)
-
-    # predicates as 0/1 f32 (Mosaic lacks i1-valued selects)
-    one = jnp.float32(1.0)
-    zero = jnp.float32(0.0)
-    nan_f = jnp.where(is_nan_bin, one, zero)
-    defl_f = jnp.where(defl, one, zero)
-    le_f = jnp.where(binv <= thr, one, zero)
-    num_gl = nan_f * defl_f + (one - nan_f) * le_f
+        seg_lo = col(_COL_SEG_LO)
+        seg_hi = col(_COL_SEG_HI)
+        pt = col(_COL_PT)
+        dbl = col(_COL_DBLEFT)
+        pnan = col(_COL_PNAN)
+        in_f = jnp.where((pval >= seg_lo) & (pval <= seg_hi), one, zero)
+        nanp_f = jnp.where(pval == pnan, one, zero)
+        le_f = jnp.where(pval <= pt, one, zero)
+        num_gl = in_f * (nanp_f * defl_f + (one - nanp_f) * le_f) + \
+            (one - in_f) * dbl
+        binv = pval  # categorical columns are identity: bin == position
+    else:
+        if fh:
+            # packed storage: pick byte column pf % fh, then the nibble
+            fh_f = jnp.float32(fh)
+            is_hi = jnp.where(pf >= fh_f, jnp.float32(1.0),
+                              jnp.float32(0.0))
+            pcol = pf - is_hi * fh_f
+            iota_p = jax.lax.broadcasted_iota(
+                jnp.int32, (nb, bins_blk.shape[1]), 1).astype(jnp.float32)
+            pbyte = jnp.sum(jnp.where(pcol == iota_p, bins_blk, 0.0),
+                            axis=1, keepdims=True)           # [nb, 1] f32
+            hi_val = jnp.floor(pbyte * jnp.float32(1.0 / 16.0))
+            binv = is_hi * hi_val + (1.0 - is_hi) * \
+                (pbyte - 16.0 * hi_val)
+        # per-feature flags (num_bins, missing_is_nan) index the
+        # full-width feature table regardless of bin packing/bundling
+        iota_f = jax.lax.broadcasted_iota(
+            jnp.int32, (nb, ftbl.shape[0]), 1).astype(jnp.float32)
+        feat_oh = (pf == iota_f)                             # [nb, L] bool
+        if loc is not None:
+            # EFB expansion fallback: bundle-column select, then
+            # original-local-bin decode through the [F, Bb] loc table
+            bcol = col(_COL_BCOL_Q) * 256.0 + col(_COL_BCOL_R)
+            iota_c = jax.lax.broadcasted_iota(
+                jnp.int32, (nb, bins_blk.shape[1]), 1).astype(jnp.float32)
+            pval = jnp.sum(jnp.where(bcol == iota_c, bins_blk, 0.0),
+                           axis=1, keepdims=True)            # [nb, 1] f32
+            # loc row of the split feature: one MXU dot (entries <= 256,
+            # bf16-exact; 0/1 lhs keeps the accumulation a selection)
+            loc_row = jax.lax.dot_general(
+                feat_oh.astype(jnp.bfloat16), loc.astype(jnp.bfloat16),
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)          # [nb, Bb]
+            iota_b2 = jax.lax.broadcasted_iota(
+                jnp.int32, (nb, loc.shape[1]), 1).astype(jnp.float32)
+            binv = jnp.sum(jnp.where(pval == iota_b2, loc_row, 0.0),
+                           axis=1, keepdims=True)            # [nb, 1] f32
+        elif not fh:
+            # column select: binv[r] = bins[r, pf[r]] via one-hot sum
+            binv = jnp.sum(jnp.where(feat_oh, bins_blk, 0.0), axis=1,
+                           keepdims=True)                    # [nb, 1] f32
+        nbins = jnp.sum(jnp.where(feat_oh, ftbl[:, 0][None, :], 0.0),
+                        axis=1, keepdims=True)
+        mnan = jnp.sum(jnp.where(feat_oh, ftbl[:, 1][None, :], 0.0),
+                       axis=1, keepdims=True) > 0.5
+        is_nan_bin = mnan & (binv == nbins - 1.0)
+        nan_f = jnp.where(is_nan_bin, one, zero)
+        le_f = jnp.where(binv <= thr, one, zero)
+        num_gl = nan_f * defl_f + (one - nan_f) * le_f
     if memb is not None:
         iscat_f = jnp.where(col(_COL_ISCAT) > 0.5, one, zero)
         bpad = memb.shape[1]
@@ -604,7 +632,7 @@ def build_histograms_mxu_auto(bins, grad, hess, cnt, row_slot, *,
 def _fused_kernel(nb: int, f: int, flane: int, b: int, s: int, m: int,
                   bpad: int, mm_dtype=jnp.bfloat16, nchan: int = 5,
                   has_cat: bool = True, fh: int = 0,
-                  has_efb: bool = False):
+                  has_efb: bool = False, efb_range: bool = False):
     """Route + histogram in ONE sweep over the binned matrix: advance each
     row through the splits committed by the previous pass (the
     _route_kernel math) and immediately scatter-accumulate it into its new
@@ -658,7 +686,7 @@ def _fused_kernel(nb: int, f: int, flane: int, b: int, s: int, m: int,
             new_node_f, new_slot_f = _route_decide(
                 node, gath, bins_ref[:].astype(jnp.int32)
                 .astype(jnp.float32), feat_tbl_ref[:], memb,
-                nb=nb, fh=fh,
+                nb=nb, fh=fh, efb_range=efb_range,
                 loc=loc_ref[:] if has_efb else None)
             node_out_ref[:] = jnp.concatenate(
                 [new_node_f, new_slot_f], axis=1).astype(jnp.int32)
@@ -683,7 +711,7 @@ def _fused_kernel(nb: int, f: int, flane: int, b: int, s: int, m: int,
 @functools.partial(
     jax.jit, static_argnames=("num_slots", "bmax", "row_block", "has_cat",
                               "double_prec", "quantized", "num_features",
-                              "interpret"))
+                              "efb_range", "interpret"))
 def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                          cnt: jax.Array, row_node: jax.Array,
                          tbl: jax.Array, member: jax.Array,
@@ -691,6 +719,7 @@ def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                          row_block: int = 4096, has_cat: bool = True,
                          double_prec: bool = True, quantized: bool = False,
                          num_features: int = 0, loc_table=None,
+                         efb_range: bool = False,
                          interpret: bool = False):
     """One sweep: route rows through the previous pass's packed split
     tables (pack_route_tables) AND build the per-slot histograms of the
@@ -708,16 +737,18 @@ def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     loc_table ([F_orig, Bb] i32/f32) marks `bins` as EFB bundle columns:
     histograms build in bundle space (f = bundle columns, bmax = Bb) and
     routing decodes the original local bin through loc_table (efb.py);
-    feat_tbl stays original-feature-indexed."""
+    feat_tbl stays original-feature-indexed. efb_range=True routes by
+    the bundle-RANGE table columns instead — no loc table, no
+    original-feature-width work (pack_route_tables efb=)."""
     n, fcols = bins.shape
-    has_efb = loc_table is not None
+    has_efb = loc_table is not None and not efb_range
     f = num_features if num_features else fcols
     fh = fcols if num_features else 0
     nb = row_block
     s = num_slots
     b = ((bmax + 127) // 128) * 128
     plane = ((fcols + 127) // 128) * 128     # bins block width (packed)
-    # route tables are original-feature-indexed under EFB
+    # route tables are original-feature-indexed under decode-mode EFB
     f_route = loc_table.shape[0] if has_efb else f
     flane = ((f_route + 127) // 128) * 128
     m, kcols = tbl.shape
@@ -729,7 +760,9 @@ def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         row_node = jnp.pad(row_node, (0, npad))
     if plane != fcols:
         bins = jnp.pad(bins, ((0, 0), (0, plane - fcols)))
-    if feat_tbl.shape[0] != flane:
+    if feat_tbl.shape[0] > flane:
+        feat_tbl = feat_tbl[:flane]   # range mode: ftbl is unused
+    elif feat_tbl.shape[0] < flane:
         feat_tbl = jnp.pad(feat_tbl,
                            ((0, flane - feat_tbl.shape[0]), (0, 0)))
     if has_efb:
@@ -746,7 +779,8 @@ def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     nblocks = (n + npad) // nb
     hist, node_out = pl.pallas_call(
         _fused_kernel(nb, f, flane, b, s, m, bpad, nchan=nchan,
-                      has_cat=has_cat, fh=fh, has_efb=has_efb),
+                      has_cat=has_cat, fh=fh, has_efb=has_efb,
+                      efb_range=efb_range),
         grid=(nblocks,),
         in_specs=[
             pl.BlockSpec((nb, 1), lambda ri: (ri, 0)),
@@ -801,16 +835,27 @@ _COL_SLOTR_Q = 14  # right child's next-pass slot // 256
 _COL_SLOTR_R = 15  # right child's next-pass slot % 256
 _COL_BCOL_Q = 16   # split feature's EFB bundle column // 256
 _COL_BCOL_R = 17   # split feature's EFB bundle column % 256
-_N_COLS = 18
+# EFB bundle-RANGE routing (efb.EfbScan route tables): the split decision
+# becomes position compares on the row's bundle bin — no original-bin
+# decode, no [rows, F]-wide work. All values <= 256 (bf16-exact).
+_COL_SEG_LO = 18   # first bundle position of the split feature's segment
+_COL_SEG_HI = 19   # last bundle position of the segment
+_COL_PT = 20       # last LEFT position for this threshold (seg_lo-1: none)
+_COL_DBLEFT = 21   # default-bin side goes left (out-of-segment rows)
+_COL_PNAN = 22     # NaN-bin position (-1: none); routes by default_left
+_N_COLS = 23
 
 
 def pack_route_tables(split_mask, feat, thr, default_left, is_cat,
                       child_l, child_r, slot_of_node, cat_bitset,
-                      m_pad: int, bmax: int, bcol=None):
+                      m_pad: int, bmax: int, bcol=None, efb=None):
     """Node tables for route_rows_mxu: ([m_pad, _N_COLS] f32 scalars,
     [m_pad, Bpad] 0/1 categorical left-set membership per bin).
     bcol: per-node EFB bundle column of the split feature (defaults to
-    the feature id itself — identity when bins are unbundled)."""
+    the feature id itself — identity when bins are unbundled).
+    efb (EfbDev with .scan tables): fills the bundle-RANGE routing
+    columns (_COL_SEG_LO.._COL_PNAN) from its static tables so the
+    kernels can run the efb_range decision; zeros otherwise."""
     m1 = split_mask.shape[0]
     w = cat_bitset.shape[1]
     bpad = ((bmax + 127) // 128) * 128
@@ -837,6 +882,22 @@ def pack_route_tables(split_mask, feat, thr, default_left, is_cat,
     slq_q, slq_r = qr(slot_l)
     srq_q, srq_r = qr(slot_r)
     bc_q, bc_r = qr(feat if bcol is None else bcol)
+    if efb is not None and getattr(efb, "scan", None) is not None:
+        er = efb.scan
+        fr = feat.astype(jnp.int32)
+        th = jnp.clip(thr.astype(jnp.int32), 0,
+                      er.pos_thresh.shape[1] - 1)
+        seg_lo_n = efb.seg_lo[fr].astype(jnp.float32)[:, None]
+        seg_hi_n = efb.seg_hi[fr].astype(jnp.float32)[:, None]
+        pt_n = er.pos_thresh[fr, th].astype(jnp.float32)[:, None]
+        dbl_n = jnp.where(er.nan_is_default[fr], default_left,
+                          er.db_le_t[fr, th]) \
+            .astype(jnp.float32)[:, None]
+        pnan_n = er.p_nan_f[fr].astype(jnp.float32)[:, None]
+    else:
+        z = jnp.zeros((m1, 1), jnp.float32)
+        seg_lo_n = seg_hi_n = pt_n = dbl_n = z
+        pnan_n = z - 1.0
     tbl = jnp.concatenate([
         split_mask.astype(jnp.float32)[:, None],
         f_r,
@@ -847,7 +908,8 @@ def pack_route_tables(split_mask, feat, thr, default_left, is_cat,
         sl_q, sl_r,
         f_q,
         slq_q, slq_r, srq_q, srq_r,
-        bc_q, bc_r], axis=1)
+        bc_q, bc_r,
+        seg_lo_n, seg_hi_n, pt_n, dbl_n, pnan_n], axis=1)
     if m_pad > m1:
         tbl = jnp.pad(tbl, ((0, m_pad - m1), (0, 0)))
         member = jnp.pad(member, ((0, m_pad - m1), (0, 0)))
@@ -856,7 +918,7 @@ def pack_route_tables(split_mask, feat, thr, default_left, is_cat,
 
 def _route_kernel(nb: int, f: int, m: int, bpad: int,
                   has_cat: bool = True, fh: int = 0,
-                  has_efb: bool = False):
+                  has_efb: bool = False, efb_range: bool = False):
     # every per-row quantity is kept [nb, 1] (2-D) — Mosaic lowers 2-D
     # masks/selects cleanly where 1-D bool vectors hit unsupported i1 casts
     def kernel(node_ref, bins_ref, tbl_ref, member_ref, feat_tbl_ref,
@@ -893,7 +955,7 @@ def _route_kernel(nb: int, f: int, m: int, bpad: int,
             new_node_f, new_slot_f = _route_decide(
                 node, gath, bins_ref[:].astype(jnp.int32)
                 .astype(jnp.float32), feat_tbl_ref[:], memb,
-                nb=nb, fh=fh,
+                nb=nb, fh=fh, efb_range=efb_range,
                 loc=loc_ref[:] if has_efb else None)
             out_ref[:] = jnp.concatenate(
                 [new_node_f, new_slot_f], axis=1).astype(jnp.int32)
@@ -902,20 +964,25 @@ def _route_kernel(nb: int, f: int, m: int, bpad: int,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("row_block", "num_features", "interpret"))
+    jax.jit, static_argnames=("row_block", "num_features", "efb_range",
+                              "interpret"))
 def route_rows_mxu(bins: jax.Array, row_node: jax.Array, tbl: jax.Array,
                    member: jax.Array, feat_tbl: jax.Array, *,
                    row_block: int = 1024, num_features: int = 0,
-                   loc_table=None, interpret: bool = False):
+                   loc_table=None, efb_range: bool = False,
+                   interpret: bool = False):
     """Advance rows one level and emit (new row_node, new row_slot).
 
     tbl/member: from pack_route_tables (M_pad lane-friendly).
     feat_tbl: [F, 2] f32: (num_bins, missing_is_nan).
     num_features > 0 marks `bins` as 4-bit packed (pack_bins_4bit).
-    loc_table marks `bins` as EFB bundle columns (fused_route_hist_mxu).
+    loc_table marks `bins` as EFB bundle columns decoded per row
+    (expansion fallback); efb_range=True instead runs the bundle-RANGE
+    decision off the packed table columns — no loc table, no
+    original-feature-width work (pack_route_tables efb=).
     """
     n, fcols = bins.shape
-    has_efb = loc_table is not None
+    has_efb = loc_table is not None and not efb_range
     f = num_features if num_features else fcols
     f_route = loc_table.shape[0] if has_efb else f
     fh = fcols if num_features else 0
@@ -926,14 +993,17 @@ def route_rows_mxu(bins: jax.Array, row_node: jax.Array, tbl: jax.Array,
     if npad:
         bins = jnp.pad(bins, ((0, npad), (0, 0)))
         row_node = jnp.pad(row_node, (0, npad))
-    if feat_tbl.shape[0] != f_route:
+    if feat_tbl.shape[0] > f_route:
+        feat_tbl = feat_tbl[:f_route]  # range mode: ftbl is unused
+    elif feat_tbl.shape[0] < f_route:
         feat_tbl = jnp.pad(feat_tbl,
                            ((0, f_route - feat_tbl.shape[0]), (0, 0)))
     loc = loc_table.astype(jnp.float32) if has_efb else \
         jnp.zeros((8, 128), jnp.float32)
     nblocks = (n + npad) // nb
     out = pl.pallas_call(
-        _route_kernel(nb, f, m, bpad, fh=fh, has_efb=has_efb),
+        _route_kernel(nb, f, m, bpad, fh=fh, has_efb=has_efb,
+                      efb_range=efb_range),
         grid=(nblocks,),
         in_specs=[
             pl.BlockSpec((nb, 1), lambda ri: (ri, 0)),

@@ -181,6 +181,38 @@ class TestEfbMXU:
         np.testing.assert_array_equal(np.asarray(bs_seg.cat_bitset),
                                       np.asarray(bs_exp.cat_bitset))
 
+    def test_sharded_efb_mxu_matches_serial(self):
+        # EFB rides the data-parallel MXU grower since round 4
+        # (gbdt._mxu_exclusions): bundle-space histograms psum across
+        # shards, segmented scan on the global sums — tree-identical to
+        # the serial MXU grower
+        import jax
+        from lightgbm_tpu.parallel import CommSpec, make_mesh
+        from lightgbm_tpu.parallel.learner import make_sharded_grower
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 virtual devices")
+        ds, efb, bund, g, h = _sparse_ds(n=4096, seg=True)
+        cnt = jnp.ones(ds.num_data, jnp.float32)
+        args = (bund, g, h, cnt,
+                jnp.ones(ds.num_features, jnp.float32),
+                jnp.asarray(ds.num_bins),
+                jnp.asarray(ds.missing_types == 2),
+                jnp.asarray(ds.is_categorical))
+        kw = dict(num_leaves=15, max_depth=0,
+                  hp=SplitHyperParams(min_data_in_leaf=20),
+                  bmax=int(ds.num_bins.max()))
+        t_s, rn_s = grow_tree_mxu(*args, interpret=True, efb=efb, **kw)
+        mesh = make_mesh(4)
+        comm = CommSpec(axis="data", mode="data", num_devices=4)
+        grower = make_sharded_grower(
+            mesh, comm, leafwise=False, use_mxu=True, interpret=True,
+            efb=efb, max_depth=0, num_leaves=15,
+            hp=SplitHyperParams(min_data_in_leaf=20),
+            bmax=int(ds.num_bins.max()))
+        with mesh:
+            t_p, rn_p = grower(*args)
+        _assert_same_tree(t_s, rn_s, t_p, rn_p)
+
     def test_quantized_with_efb(self):
         ds, efb, bund, g, h = _sparse_ds(seed=4)
         cnt = jnp.ones(ds.num_data, jnp.float32)
