@@ -255,8 +255,9 @@ def _report(result, block_times, block_trees, bench):
               file=sys.stderr)
         print("# note: vs_baseline uses the reference's published "
               "10.5M-row 28-core Higgs rate; same-host single-core "
-              "reference on THIS synthetic 1M-row set measured 2.96 "
-              "trees/sec (docs/PerfNotes.md)", file=sys.stderr)
+              "reference on THIS synthetic 1M-row set measured "
+              "2.96-3.43 trees/sec (loaded/idle host, "
+              "docs/PerfNotes.md)", file=sys.stderr)
     except Exception as exc:
         print(f"# detail reporting failed: {type(exc).__name__}: {exc}",
               file=sys.stderr)

@@ -44,7 +44,14 @@ def main():
             "learning_rate": 0.1, "min_data_in_leaf": 20,
             "verbosity": -1, **extra}, train_set=ds)
         t0 = time.time()
-        bst.update_batch(n_trees)
+        # 20-tree dispatches: one giant fused scan of 200 trees crashed
+        # the remoted TPU worker twice (long-dispatch tunnel limit)
+        done = 0
+        while done < n_trees:
+            step = min(20, n_trees - done)
+            bst.update_batch(step)
+            float(np.asarray(bst.gbdt.train_score[:1])[0])
+            done += step
         sc = bst.predict(Xva, raw_score=True)
         out[name] = AUCMetric._auc_fast(sc, yva > 0, wva)
         print(f"ours[{name}]: AUC@{bst.current_iteration()} = "

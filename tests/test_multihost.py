@@ -123,6 +123,9 @@ def _run_two_process(tmp_path, uneven, objective, exact):
 
     # and it equals single-process training on the concatenated data
     import lightgbm_tpu as lgb
+    # each test writes its own conftest_data.py variant; drop any cached
+    # module from an earlier test's tmp dir or the import is shadowed
+    sys.modules.pop("conftest_data", None)
     sys.path.insert(0, str(tmp_path))
     try:
         from conftest_data import make_data
@@ -374,6 +377,7 @@ def test_two_process_efb_matches_single(tmp_path):
     assert strip_port(m0) == strip_port(outs[1].read_text())
 
     import lightgbm_tpu as lgb
+    sys.modules.pop("conftest_data", None)  # see test_two_process note
     sys.path.insert(0, str(tmp_path))
     try:
         from conftest_data import make_sparse_data
