@@ -90,7 +90,7 @@ def _probe_with_retry() -> str:
 PARAMS = {"objective": "binary", "num_leaves": NUM_LEAVES,
           "learning_rate": 0.1, "max_bin": MAX_BIN, "verbosity": -1,
           "min_data_in_leaf": 20, "use_quantized_grad": True,
-          "growth_overshoot": 1.75}
+          "growth_overshoot": 1.75, "growth_bridge_gate": 0.93}
 # Bench posture vs library defaults (both A/B'd, docs/PerfNotes.md):
 # - use_quantized_grad: stochastically-rounded integer gradients with
 #   exact leaf refit. Round-3 A/B: 2.31 vs 1.74 trees/s, AUC@95
@@ -99,7 +99,13 @@ PARAMS = {"objective": "binary", "num_leaves": NUM_LEAVES,
 # - growth_overshoot 1.75 (default 2.0): round-4 A/B at 105 trees:
 #   1.75 -> 2.83-3.4 t/s AUC 0.98098; 2.0 -> 2.68 t/s AUC 0.98129
 #   (~3e-4, same order as quantization). 1.5 costs 1.1e-3 — rejected.
-# The held-out AUC is printed below either way.
+# - growth_bridge_gate 0.93 (default 0 = full chase): skips the
+#   s_max-wide bridge sweep for trees already within 7% of the
+#   overshoot target; A/B at 115 trees: median 3.03 AUC 0.98143 vs
+#   2.85 AUC 0.98167 (~2.4e-4).
+# The held-out AUC is printed below either way; the 200-tree
+# differential vs the reference binary re-certifies the cumulative
+# posture cost (helpers/recert_auc_parity.py).
 
 
 def _drain(booster):

@@ -485,6 +485,7 @@ class GBDT:
                 tail_split_cap=cfg.tail_split_cap,
                 hist_subtraction=cfg.hist_subtraction,
                 overshoot=cfg.growth_overshoot,
+                bridge_gate=cfg.growth_bridge_gate,
                 quantized_grad=cfg.use_quantized_grad))
         Log.info("Distributed learner: %s-parallel over %d devices%s",
                  self.comm.mode, ndev, " (mxu)" if use_mxu else "")
@@ -556,6 +557,7 @@ class GBDT:
             tail_split_cap=cfg.tail_split_cap,
             hist_subtraction=cfg.hist_subtraction,
             overshoot=cfg.growth_overshoot,
+            bridge_gate=cfg.growth_bridge_gate,
             quantized_grad=cfg.use_quantized_grad,
             packed4=self._packed4,
             interpret=getattr(self, "_mxu_interpret", False))

@@ -251,6 +251,16 @@ _PARAMS: List[ParamSpec] = [
        "gains and prune (serial_tree_learner.cpp:159). Exact leaf-wise "
        "trees when the overshoot covers every best-first pick (~3x is "
        "ample). 0 = off (tail_split_cap hybrid growth instead)"),
+    _p("growth_bridge_gate", float, 0.0, (),
+       lambda v: 0.0 <= v <= 1.0,
+       "overgrow-and-prune early-exit: skip the full-capacity bridge "
+       "pass and fixup sweeps when the doubling schedule already grew "
+       "at least this fraction of overshoot*num_leaves leaves (0 = "
+       "always chase the full overshoot). The bridge is an s_max-wide "
+       "histogram sweep (~65 ms at the Higgs bench shape) that runs "
+       "exactly for the mid/late-boosting trees whose throttled last "
+       "pass under-commits; 0.93 measured +6% throughput for ~2.4e-4 "
+       "AUC@115 (docs/PerfNotes.md round 4)"),
     _p("tail_split_cap", int, 8, (), lambda v: v >= 0,
        "hybrid growth throttle for the batched TPU grower: once fewer "
        "leaves remain than splittable candidates, commit at most this "

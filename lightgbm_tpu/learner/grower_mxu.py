@@ -176,7 +176,8 @@ def _kernel_cap(s: int) -> int:
     static_argnames=("num_leaves", "max_depth", "hp", "bmax",
                      "interaction_groups", "feature_fraction_bynode",
                      "interpret", "hist_double_prec", "tail_split_cap",
-                     "hist_subtraction", "overshoot", "psum_axis",
+                     "hist_subtraction", "overshoot", "bridge_gate",
+                     "psum_axis",
                      "quantized_grad", "use_scan_kernel", "packed4",
                      "cegb_cfg", "debug_info"))
 def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
@@ -193,6 +194,7 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   tail_split_cap: int = 0,
                   hist_subtraction: bool = True,
                   overshoot: float = 0.0,
+                  bridge_gate: float = 0.0,
                   psum_axis: Optional[str] = None,
                   quantized_grad: bool = False,
                   use_scan_kernel: bool = False,
@@ -877,14 +879,15 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # tail passes are per-pass-floor bound; with a hybrid-growth cap the
     # frontier only ever holds 2*cap fresh children, so shrink the fixup
     # scan capacity accordingly
-    # NOTE a coverage gate here (stop fixups once num_leaves >= target,
-    # letting the prune work with schedule-only coverage) was measured
-    # at +0.85 trees/s but -0.0035 AUC@95 — the replay regularly KEEPS
-    # fixup-grown splits, so overshoot quality needs the full chase.
-    # Instead the overshoot fixup frontier is widened (128 vs 64): the
-    # same leftover splits commit in roughly half the passes (throttled
-    # trees late in boosting ran 10+ narrow fixup sweeps, decaying
-    # 2.09 -> 1.70 trees/s over 95 trees).
+    # NOTE on gates, two different animals (r3 vs r4):
+    # - gating at the TARGET (stop fixups once num_leaves >= num_leaves,
+    #   coverage 1.0x) was measured in r3 at +0.85 trees/s but
+    #   -3.5e-3 AUC@95 — REJECTED; the replay regularly keeps
+    #   fixup-grown splits, so overshoot quality needs most of the
+    #   chase. The r3 answer was widening the fixup frontier instead.
+    # - gating near the OVERSHOOT (growth_bridge_gate, below: skip the
+    #   bridge once num_leaves >= gate*L_g, coverage ~gate*overshoot)
+    #   costs only ~2.4e-4 AUC@115 for +6% — the r4 bench posture.
     if over:
         # FULL-frontier fixup capacity: the round-3 "unresolved
         # late-tree decay" (2.69 early -> 2.3 steady) was fixup passes —
@@ -905,6 +908,19 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         s_fix = min(s_max, max(16, 2 * tail_split_cap))
         sk_fix = _kernel_cap(s_fix) if hist_subtraction else None
     k_fix = max(1, s_fix // 2)
+    # bridge gate (growth_bridge_gate): a tree that ended the schedule
+    # within `gate` of the full overshoot skips the bridge + fixups —
+    # the s_max-wide bridge sweep is ~65 ms and runs exactly for the
+    # mid/late-boosting trees whose throttled last pass under-commits
+    # (the round-3 "unresolved" residual, isolated by the fresh-booster
+    # probe in docs/PerfNotes.md round 4)
+    if over and bridge_gate > 0:
+        # never gate below the actual leaf budget: a gate*overshoot < 1
+        # config must not starve the prune of its num_leaves target
+        gate_leaves = max(int(bridge_gate * L_g), num_leaves)
+        st_l = list(state)
+        st_l[_DONE] = st_l[_DONE] | (state[0].num_leaves >= gate_leaves)
+        state = tuple(st_l)
     if schedule:
         state = cond_pass(s_max, state, len(schedule), k_cap=k_fix,
                           sk_next=sk_fix)

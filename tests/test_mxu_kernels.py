@@ -186,6 +186,27 @@ class TestMXUGrower:
             mismatch = np.mean(np.abs(v_lw - vals_rows) > 1e-2)
             assert mismatch < 0.02, f"row mismatch rate {mismatch}"
 
+    def test_overshoot_bridge_gate_valid_tree(self):
+        # growth_bridge_gate skips the bridge/fixups for near-complete
+        # trees; the pruned tree must still reach the leaf budget and
+        # stay self-consistent (the gate only trims overshoot COVERAGE,
+        # never the final structure invariants)
+        from lightgbm_tpu.learner.predict import predict_binned_tree
+        ds, g, h = _data(n=6000, f=8, seed=9, with_nan=True)
+        args = _mxu_args(ds, g, h)
+        t, r = grow_tree_mxu(
+            *args, num_leaves=31, max_depth=0,
+            hp=SplitHyperParams(min_data_in_leaf=20),
+            bmax=int(ds.num_bins.max()), interpret=True, overshoot=2.0,
+            bridge_gate=0.93)
+        assert int(t.num_leaves) == 31
+        vals_route = predict_binned_tree(
+            t, args[0], jnp.asarray(ds.num_bins),
+            jnp.asarray(ds.missing_types == 2))
+        vals_rows = np.asarray(t.leaf_value)[np.asarray(r)]
+        np.testing.assert_allclose(np.asarray(vals_route), vals_rows,
+                                   rtol=1e-5, atol=1e-6)
+
     def test_overshoot_respects_max_depth(self):
         # overgrow-and-prune must not let the overshoot expansion smuggle
         # in nodes deeper than max_depth
