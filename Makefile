@@ -12,8 +12,24 @@ PY ?= python
 test:
 	$(PY) -m pytest tests/ -x -q
 
+# batched: the whole slow tier in ONE pytest process hard-crashed the
+# interpreter twice (not OOM; see TESTS.md round 4) — per-batch runs
+# are 100% green and are the supported invocation
 test-slow:
-	$(PY) -m pytest tests/ -x -q -m slow
+	$(PY) -m pytest tests/test_mxu_kernels.py tests/test_mxu_smoke.py \
+	  tests/test_mxu_forced_cegb.py -x -q -m slow
+	$(PY) -m pytest tests/test_efb.py tests/test_efb_mxu.py \
+	  tests/test_packed_bins.py tests/test_fused.py \
+	  tests/test_bench_robustness.py tests/test_dask_stub.py -x -q -m slow
+	$(PY) -m pytest tests/test_multihost.py tests/test_distributed.py \
+	  tests/test_cli.py -x -q -m slow
+	$(PY) -m pytest tests/ -x -q -m slow --ignore=tests/test_mxu_kernels.py \
+	  --ignore=tests/test_mxu_smoke.py --ignore=tests/test_mxu_forced_cegb.py \
+	  --ignore=tests/test_efb.py --ignore=tests/test_efb_mxu.py \
+	  --ignore=tests/test_packed_bins.py --ignore=tests/test_fused.py \
+	  --ignore=tests/test_bench_robustness.py --ignore=tests/test_dask_stub.py \
+	  --ignore=tests/test_multihost.py --ignore=tests/test_distributed.py \
+	  --ignore=tests/test_cli.py
 
 test-all: test test-slow
 
