@@ -120,6 +120,55 @@ class TestForcedMXUGrower:
         _assert_same_tree(t_s, t_p, rn_s, rn_p)
 
 
+class TestForcedWithEfbMXU:
+    def test_forced_split_bundled_matches_portable(self):
+        # forced stats under SEGMENTED EFB come from a per-slot
+        # bundle-space expansion gather (grower_mxu one_pass) — compare
+        # against the portable grower's expansion-based forced path
+        from lightgbm_tpu.efb import (build_plan, bundle_matrix,
+                                      make_device_tables)
+        rng = np.random.RandomState(3)
+        n, f = 4000, 24
+        X = np.zeros((n, f))
+        for g0 in range(0, f, 8):
+            which = rng.randint(g0, g0 + 8, size=n)
+            X[np.arange(n), which] = rng.rand(n) + 0.5
+        y = (X[:, 0] + X[:, 8] > 0.8).astype(np.float32)
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 15}).binned
+        plan = build_plan(np.asarray(ds.bins), ds.num_bins,
+                          ds.default_bins,
+                          np.asarray(ds.is_categorical),
+                          max_bundle_bins=256)
+        assert plan is not None and plan.effective
+        efb = make_device_tables(
+            plan, ds.default_bins, num_bins=ds.num_bins,
+            missing_is_nan=(ds.missing_types == 2),
+            is_cat=np.asarray(ds.is_categorical))
+        assert efb.scan is not None
+        bund = jnp.asarray(bundle_matrix(np.asarray(ds.bins), plan))
+        p = np.full(n, 0.5, np.float32)
+        g = jnp.asarray(p - y)
+        h = jnp.asarray(p * (1 - p))
+        cnt = jnp.ones(n, jnp.float32)
+        args = (bund, g, h, cnt, jnp.ones(f, jnp.float32),
+                jnp.asarray(ds.num_bins),
+                jnp.asarray(ds.missing_types == 2),
+                jnp.asarray(ds.is_categorical))
+        # force feature 5 (a bundled sparse feature) at its median bin
+        nb5 = int(ds.num_bins[5])
+        forced = (jnp.asarray([5], jnp.int32),
+                  jnp.asarray([max(0, nb5 // 2 - 1)], jnp.int32),
+                  jnp.asarray([-1], jnp.int32),
+                  jnp.asarray([-1], jnp.int32))
+        kw = dict(num_leaves=15, max_depth=0,
+                  hp=SplitHyperParams(min_data_in_leaf=20),
+                  bmax=int(ds.num_bins.max()), forced=forced, efb=efb)
+        t_p, rn_p = grow_tree(*args, leafwise=False, **kw)
+        t_m, rn_m = grow_tree_mxu(*args, interpret=True, **kw)
+        _assert_same_tree(t_p, t_m, rn_p, rn_m)
+        assert int(t_m.split_feature[0]) == 5
+
+
 class TestCegbMXUGrower:
     def _cegb(self, f, coupled_pen):
         cfg = CegbParams(tradeoff=1.0, penalty_split=0.01,
