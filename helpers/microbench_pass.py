@@ -1,0 +1,174 @@
+"""Per-pass floor microbench at the MAIN bench shape (1M x 28 x 255).
+
+Round-4 closed with per-tree time ~= dots(123ms) + per-pass floors
+(~15ms x ~10) + recon(36ms) + glue(30ms); the floors are now the
+largest line item (docs/PerfNotes.md).  This times the fused
+route+hist sweep (the whole per-pass kernel cost) across kernel-slot
+counts and row blocks to separate:
+  - MXU row-padding waste (C*sk < 128 on early passes),
+  - per-grid-step overhead (489 steps at row_block=2048),
+  - the dot's true slot-proportional cost,
+and times the sibling-reconstruction dot at f32-HIGHEST vs an exact
+split-bf16 2-pass formulation.
+
+Usage: python helpers/microbench_pass.py [sweep|recon|all]
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+N = 1_000_000
+F = 28
+BMAX = 256
+M_PAD = 896          # round_up(2*447-1+1, 128) at overshoot 1.75
+
+
+def timeit(fn, *args, reps=10, **kw):
+    out = fn(*args, **kw)
+    jax.tree_util.tree_map(lambda a: np.asarray(a).ravel()[:1], out)
+    t0 = time.time()
+    for _ in range(reps):
+        out = fn(*args, **kw)
+    jax.tree_util.tree_map(lambda a: np.asarray(a).ravel()[:1], out)
+    return (time.time() - t0) / reps
+
+
+def make_pass_state(sk, rng):
+    """Tables emulating a mid-tree pass: sk parents split last pass,
+    children carry kernel slots, rows sit in the parents."""
+    from lightgbm_tpu.learner.histogram_mxu import pack_route_tables
+    m1 = M_PAD
+    ids = np.arange(m1)
+    split = ids < sk
+    feat = ids % F
+    thr = np.full(m1, 128)
+    child_l = np.where(split, sk + 2 * ids, -1)
+    child_r = np.where(split, sk + 2 * ids + 1, -1)
+    slot = np.full(m1, -1)
+    child_ids = ids - sk
+    is_child = (ids >= sk) & (ids < 3 * sk)
+    slot[is_child] = child_ids[is_child] % sk
+    tbl, member = pack_route_tables(
+        jnp.asarray(split), jnp.asarray(feat, jnp.int32),
+        jnp.asarray(thr, jnp.int32), jnp.zeros(m1, bool),
+        jnp.zeros(m1, bool), jnp.asarray(child_l, jnp.int32),
+        jnp.asarray(child_r, jnp.int32), jnp.asarray(slot, jnp.int32),
+        jnp.zeros((m1, (BMAX + 31) // 32), jnp.uint32), M_PAD, BMAX)
+    row_node = jnp.asarray(rng.randint(0, max(sk, 1), N), jnp.int32)
+    return tbl, member, row_node
+
+
+def bench_sweep():
+    from lightgbm_tpu.learner.histogram_mxu import fused_route_hist_mxu
+    rng = np.random.RandomState(0)
+    bins = jnp.asarray(rng.randint(0, BMAX, (N, F)), jnp.uint8)
+    g = jnp.asarray(rng.randint(-127, 128, N), jnp.float32)
+    h = jnp.asarray(rng.randint(0, 128, N), jnp.float32)
+    cnt = jnp.ones(N, jnp.float32)
+    feat_tbl = jnp.stack([jnp.full(F, 255.0), jnp.zeros(F)], axis=1)
+
+    print("# fused_route_hist_mxu, quantized (3ch), m table rows below")
+    print("sk\trb\tm_cap\tms")
+    for sk in (2, 9, 16, 24, 40, 72, 136, 232):
+        tbl, member, row_node = make_pass_state(sk, rng)
+        for rb in (2048, 4096, 8192, 16384):
+            for m_cap in ({128, M_PAD} if sk <= 24 else {M_PAD}):
+                if 3 * sk > m_cap:
+                    continue
+                t = tbl[:m_cap]
+                mem = member[:m_cap]
+                try:
+                    dt = timeit(
+                        fused_route_hist_mxu, bins, g, h, cnt, row_node,
+                        t, mem, feat_tbl, num_slots=sk, bmax=BMAX,
+                        has_cat=False, double_prec=True, quantized=True,
+                        row_block=rb)
+                except Exception as e:
+                    print(f"{sk}\t{rb}\t{m_cap}\tFAIL {type(e).__name__}")
+                    continue
+                print(f"{sk}\t{rb}\t{m_cap}\t{dt * 1e3:.2f}", flush=True)
+
+
+def bench_recon():
+    s, sk, p_all = 448, 232, 226
+    fb3 = F * BMAX * 3
+    rng = np.random.RandomState(1)
+    kern2 = jnp.asarray(rng.rand(sk, fb3), jnp.float32)
+    parent = jnp.asarray(rng.rand(p_all, fb3), jnp.float32)
+    mk = jnp.asarray(rng.randint(-1, 2, (s, sk)), jnp.float32)
+    mp = jnp.asarray((rng.rand(s, p_all) < 0.01), jnp.float32)
+
+    @jax.jit
+    def recon_highest(mk, mp, kern2, parent):
+        return jax.lax.dot_general(
+            jnp.concatenate([mk, mp], axis=1),
+            jnp.concatenate([kern2, parent], axis=0),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)
+
+    @jax.jit
+    def recon_split(mk, mp, kern2, parent):
+        lhs = jnp.concatenate([mk, mp], axis=1).astype(jnp.bfloat16)
+        rhs = jnp.concatenate([kern2, parent], axis=0)
+        hi = jax.lax.reduce_precision(rhs, exponent_bits=8,
+                                      mantissa_bits=7)
+        lo = rhs - hi
+        d = lambda r: jax.lax.dot_general(
+            lhs, r.astype(jnp.bfloat16),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return d(hi) + d(lo)
+
+    a = timeit(recon_highest, mk, mp, kern2, parent)
+    b = timeit(recon_split, mk, mp, kern2, parent)
+    ra = np.asarray(recon_highest(mk, mp, kern2, parent))
+    rb = np.asarray(recon_split(mk, mp, kern2, parent))
+    rel = np.abs(ra - rb).max() / max(np.abs(ra).max(), 1e-30)
+    print(f"# recon dot [s={s}, {sk}+{p_all}] x [{fb3}]")
+    print(f"highest\t{a * 1e3:.2f} ms")
+    print(f"split2\t{b * 1e3:.2f} ms\tmax rel diff {rel:.2e}")
+
+    # the parent-carry dot (sel_p), same shapes transposed
+    selp = jnp.asarray((rng.rand(p_all, s) < 0.004), jnp.float32)
+    hist = jnp.asarray(rng.rand(s, fb3), jnp.float32)
+
+    @jax.jit
+    def carry_highest(selp, hist):
+        return jax.lax.dot_general(
+            selp, hist, dimension_numbers=(((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)
+
+    @jax.jit
+    def carry_split(selp, hist):
+        hi = jax.lax.reduce_precision(hist, exponent_bits=8,
+                                      mantissa_bits=7)
+        sl = selp.astype(jnp.bfloat16)
+        d = lambda r: jax.lax.dot_general(
+            sl, r.astype(jnp.bfloat16),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return d(hi) + d(hist - hi)
+
+    a = timeit(carry_highest, selp, hist)
+    b = timeit(carry_split, selp, hist)
+    print(f"carry_highest\t{a * 1e3:.2f} ms")
+    print(f"carry_split\t{b * 1e3:.2f} ms")
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("sweep", "all"):
+        bench_sweep()
+    if which in ("recon", "all"):
+        bench_recon()
