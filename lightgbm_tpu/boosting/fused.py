@@ -22,9 +22,12 @@ bit-identical. Multiclass grows num_class trees per scan step
 (gbdt.cpp:371 TrainOneIter's per-class loop).
 
 Eligibility is decided by the caller (GBDT.train_many): serial MXU
-growth path, plain gbdt/goss boosting, no validation-score replay, no
-L1-family leaf renewal — every excluded feature falls back to the
-per-iteration path unchanged.
+growth path, plain gbdt/goss boosting, no L1-family leaf renewal —
+every excluded feature falls back to the per-iteration path unchanged.
+Validation sets DO ride along (round 5): the stacked block is replayed
+over each valid set after the dispatch (stacked_score_traj), giving
+the exact per-iteration valid-score trajectory for metric evaluation
+and early stopping between dispatches.
 """
 
 from __future__ import annotations
@@ -34,7 +37,35 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["build_fused_train"]
+__all__ = ["build_fused_train", "stacked_score_traj"]
+
+
+@functools.partial(jax.jit, static_argnames=("num_class",))
+def stacked_score_traj(stacked, score0, bins, num_bins, missing_is_nan,
+                       *, num_class: int = 1):
+    """Per-iteration score trajectory of a stacked tree block over a
+    binned matrix: scan the K stacked trees from `score0`, returning
+    (final score, [K, ...] score after each iteration). This replays
+    the per-iteration valid-score updates (gbdt._update_score — the
+    reference's AddScore(valid) cadence, score_updater.hpp:21-110) for
+    a block trained by the fused scan: leaf values in `stacked` already
+    carry shrinkage, so the trajectory is exactly what K train_one_iter
+    calls would have left on the valid set, one point per iteration."""
+    from ..learner.predict import predict_binned_tree
+
+    def body(s, tr):
+        if num_class == 1:
+            s = s + predict_binned_tree(tr, bins, num_bins,
+                                        missing_is_nan)
+        else:
+            for cls in range(num_class):
+                tcls = jax.tree_util.tree_map(lambda a: a[cls], tr)
+                s = s.at[:, cls].add(
+                    predict_binned_tree(tcls, bins, num_bins,
+                                        missing_is_nan))
+        return s, s
+
+    return jax.lax.scan(body, score0, stacked)
 
 
 def build_fused_train(*, objective, bins, cnt_weight, feature_mask_fn,

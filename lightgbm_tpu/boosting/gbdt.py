@@ -910,12 +910,16 @@ class GBDT:
         cfg = self.config
         return (type(self) is GBDT and cfg.boosting in ("gbdt", "goss")
                 and self._grower is None and self._hist_impl == "mxu"
-                and not self.valid_sets and not self._linear
+                and not self._linear
                 and self.objective is not None
                 and not self.objective.need_renew_tree_output
                 and self._cegb_cfg is None)  # feat_used carries across
         #       trees (a scan-carry the fused body doesn't thread);
-        #       forced splits are per-tree static and ride along
+        #       forced splits are per-tree static and ride along.
+        #       valid_sets ride along too (round 5): the stacked trees
+        #       are replayed over each valid set AFTER the dispatch
+        #       (_stacked_score_traj), reproducing the per-iteration
+        #       score updates exactly
 
     def _fused_sample_fn(self):
         """In-scan bagging/GOSS (fused.py contract): returns
@@ -1002,23 +1006,49 @@ class GBDT:
         the per-iteration path for this batch instead of propagating;
         after two consecutive fused failures the fused path is disabled
         for the rest of this booster's life."""
+        # per-iteration valid-score trajectory of this batch (engine
+        # block dispatch evaluates/early-stops from it). EVERY path
+        # through this method — fused, per-iteration fallback, stalled —
+        # completes the full k iterations and leaves a k-point
+        # trajectory, so block size and eval cadence never depend on
+        # eligibility or faults.
+        self._fused_valid_traj = None
+        traj_pts = [[] for _ in self.valid_sets] if self.valid_sets \
+            else None
+
+        def _snap():
+            if traj_pts is not None:
+                for i in range(len(traj_pts)):
+                    traj_pts[i].append(self.valid_scores[i])
+
+        def _seal():
+            if traj_pts is not None and traj_pts[0]:
+                self._fused_valid_traj = [jnp.stack(p) for p in traj_pts]
+
+        stop = False
         if self.iter_ == 0 and k > 0:
             # the first iteration owns boost_from_average / init-score
             # plumbing (host-side floats); run it on the normal path
-            if self.train_one_iter():
-                return True
+            stop = self.train_one_iter()
             k -= 1
+            _snap()
+            if stop:
+                # stalled at iteration 0: still complete the batch
+                # (constant trees), like every other path here
+                for _ in range(k):
+                    self.train_one_iter()
+                    _snap()
+                _seal()
+                return True
         if k <= 0:
-            return False
+            _seal()
+            return stop
         if not self._fused_eligible() or getattr(
                 self, "_fused_disabled", False):
-            # complete the whole batch like the fused path does (extra
-            # iterations on a stalled model append harmless constant
-            # trees), so batch size and iteration count never depend on
-            # eligibility
-            stop = False
             for _ in range(k):
                 stop = self.train_one_iter() or stop
+                _snap()
+            _seal()
             return stop
         saved_rng = self._rng_key
         try:
@@ -1049,13 +1079,33 @@ class GBDT:
                 % (type(exc).__name__, exc,
                    " and disabling the fused path" if
                    getattr(self, "_fused_disabled", False) else ""))
-            stop = False
             for _ in range(k):
                 stop = self.train_one_iter() or stop
+                _snap()
+            _seal()
             return stop
         self._fused_failures = 0
         self.train_score = score
         kcls = self.num_tree_per_iteration
+        if self.valid_sets:
+            # replay the stacked block over each valid set — one scanned
+            # dispatch per set yields the exact per-iteration valid-score
+            # trajectory (the engine's block path evaluates metrics /
+            # early stopping at every inner iteration from it); any
+            # normal-path points already snapped (iteration 0) lead it
+            from .fused import stacked_score_traj
+            trajs = []
+            for i in range(len(self.valid_sets)):
+                fin, traj = stacked_score_traj(
+                    stacked, self.valid_scores[i], self.valid_bins[i],
+                    self.num_bins_d, self.missing_is_nan_d,
+                    num_class=kcls)
+                if traj_pts is not None and traj_pts[i]:
+                    traj = jnp.concatenate(
+                        [jnp.stack(traj_pts[i]), traj])
+                self.valid_scores[i] = fin
+                trajs.append(traj)
+            self._fused_valid_traj = trajs
         for i in range(k):
             for c in range(kcls):
                 self.trees.append(jax.tree_util.tree_map(

@@ -49,6 +49,8 @@ def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
                 for x in env.evaluation_result_list)
             Log.info("[%d]\t%s", env.iteration + 1, result)
     _callback.order = 10
+    # reads only evaluation results; safe under engine block dispatch
+    _callback.block_safe = True
     return _callback
 
 
@@ -76,6 +78,7 @@ def record_evaluation(eval_result: Dict[str, Dict[str, List[float]]]
             eval_result[data_name].setdefault(eval_name, [])
             eval_result[data_name][eval_name].append(result)
     _callback.order = 20
+    _callback.block_safe = True
     return _callback
 
 
@@ -196,4 +199,5 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
             if last_round:
                 _stop(trk, "Did not meet early stopping.", metric_name)
     _callback.order = 30
+    _callback.block_safe = True
     return _callback

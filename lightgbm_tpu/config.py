@@ -294,6 +294,15 @@ _PARAMS: List[ParamSpec] = [
             "MXU histogram kernels (3 channels instead of 5, ~1.5x "
             "faster); leaf values are refit exactly afterwards, so "
             "quantization only perturbs the split search"),
+    _p("fused_block_size", int, 10, (), lambda v: v >= 1,
+       "iterations per fused on-device dispatch in engine.train when "
+       "the config is fused-eligible (boosting/fused.py). Metrics, "
+       "callbacks, and early stopping still run for EVERY iteration — "
+       "valid scores come from the block's per-iteration trajectory, "
+       "and an early stop mid-block rolls the extra trees back — so "
+       "results match per-iteration training exactly; the win is one "
+       "host round-trip per block instead of per tree. 1 = dispatch "
+       "per iteration (the reference's cadence, gbdt.cpp:371)"),
 ]
 
 _SPEC_BY_NAME: Dict[str, ParamSpec] = {p.name: p for p in _PARAMS}

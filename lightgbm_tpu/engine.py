@@ -156,25 +156,106 @@ def train(params: Dict[str, Any], train_set: Dataset,
                              key=lambda cb: getattr(cb, "order", 0))
 
     booster.best_iteration = -1
+    # block dispatch (TPU host-boundary amortization): when nothing in
+    # the loop needs a per-iteration host boundary — no before_iteration
+    # callbacks, no custom fobj/feval, no training-set metrics — train
+    # fused_block_size iterations per device dispatch and run the
+    # per-iteration metric/callback protocol from the block's valid-score
+    # trajectory (GBDT.train_many). Results are identical to b=1: every
+    # iteration is still evaluated, and an early stop mid-block rolls
+    # the extra trees back before propagating.
+    block = int(getattr(booster.config, "fused_block_size", 1) or 1)
+    # after-callbacks must not read model state: at inner iteration j
+    # the booster already holds the whole block's trees. The library's
+    # own eval-driven callbacks are marked block_safe; any custom
+    # callback forces the per-iteration cadence.
+    cbs_block_safe = all(getattr(cb, "block_safe", False)
+                         for cb in callbacks_after)
+    use_blocks = (block > 1 and fobj is None and feval is None
+                  and not callbacks_before and cbs_block_safe
+                  and not is_valid_contain_train
+                  and getattr(booster.gbdt, "_fused_eligible",
+                              lambda: False)())
+
+    def _eval_at(i):
+        evaluation_result_list = []
+        if valid_sets is not None or feval is not None:
+            if is_valid_contain_train:
+                evaluation_result_list.extend(booster.eval_train(feval))
+            if reduced_valid_sets:
+                evaluation_result_list.extend(booster.eval_valid(feval))
+        for cb in callbacks_after:
+            cb(callback_mod.CallbackEnv(
+                model=booster, params=params, iteration=i,
+                begin_iteration=0, end_iteration=num_boost_round,
+                evaluation_result_list=evaluation_result_list))
+        return evaluation_result_list
+
+    evaluation_result_list = []
     try:
-        for i in range(num_boost_round):
+        i = 0
+        while i < num_boost_round:
+            b = min(block, num_boost_round - i) if use_blocks else 1
+            if b > 1:
+                booster.update_batch(b)
+                gb = booster.gbdt
+                traj = getattr(gb, "_fused_valid_traj", None)
+                if traj is not None and reduced_valid_sets:
+                    # evaluate every inner iteration from the trajectory
+                    # (the last point IS the final score, so valid
+                    # scores end the loop in their live state)
+                    for j in range(b):
+                        for vi in range(len(traj)):
+                            gb.valid_scores[vi] = traj[vi][j]
+                        try:
+                            evaluation_result_list = _eval_at(i + j)
+                        except callback_mod.EarlyStopException:
+                            # restore block-final scores, roll the
+                            # post-stop trees back, then pin the valid
+                            # scores to the exact trajectory point (the
+                            # rollback's add-then-subtract would leave
+                            # ULP-level residue; train_score keeps the
+                            # subtractive form — the booster is normally
+                            # returned at this point, and the residue is
+                            # bounded by one rounding per rolled tree)
+                            for vi in range(len(traj)):
+                                gb.valid_scores[vi] = traj[vi][b - 1]
+                            for _ in range(b - 1 - j):
+                                booster.rollback_one_iter()
+                            for vi in range(len(traj)):
+                                gb.valid_scores[vi] = traj[vi][j]
+                            raise
+                        except BaseException:
+                            # any other exit (custom abort,
+                            # KeyboardInterrupt): leave the booster
+                            # consistent — trees hold the full block, so
+                            # scores must too
+                            for vi in range(len(traj)):
+                                gb.valid_scores[vi] = traj[vi][b - 1]
+                            raise
+                elif reduced_valid_sets:
+                    # belt-and-braces, believed unreachable: train_many
+                    # seals a full trajectory on every completing path
+                    # (fused, fault fallback, ineligible, stalled).
+                    # Were it ever to fire, evaluation degrades to
+                    # block-end cadence rather than reading stale
+                    # intermediate valid scores.
+                    evaluation_result_list = _eval_at(i + b - 1)
+                else:
+                    # no valid sets: no eval work, but user callbacks
+                    # still fire once per iteration
+                    for j in range(b):
+                        evaluation_result_list = _eval_at(i + j)
+                i += b
+                continue
             for cb in callbacks_before:
                 cb(callback_mod.CallbackEnv(
                     model=booster, params=params, iteration=i,
                     begin_iteration=0, end_iteration=num_boost_round,
                     evaluation_result_list=None))
             booster.update(fobj=fobj)
-            evaluation_result_list = []
-            if valid_sets is not None or feval is not None:
-                if is_valid_contain_train:
-                    evaluation_result_list.extend(booster.eval_train(feval))
-                if reduced_valid_sets:
-                    evaluation_result_list.extend(booster.eval_valid(feval))
-            for cb in callbacks_after:
-                cb(callback_mod.CallbackEnv(
-                    model=booster, params=params, iteration=i,
-                    begin_iteration=0, end_iteration=num_boost_round,
-                    evaluation_result_list=evaluation_result_list))
+            evaluation_result_list = _eval_at(i)
+            i += 1
     except callback_mod.EarlyStopException as es:
         # with continued training, iteration indexing covers the merged
         # model (base trees first), matching predict(num_iteration=...)

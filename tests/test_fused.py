@@ -146,3 +146,86 @@ class TestFusedScan:
             np.testing.assert_array_equal(np.asarray(ta.leaf_value),
                                           np.asarray(tb.leaf_value))
         assert a.model_to_string() == b.model_to_string()
+
+
+@pytest.mark.slow
+class TestFusedValidSets:
+    """Round-5 eligibility widening: valid sets ride the fused scan —
+    the stacked block is replayed over each valid set after the
+    dispatch (fused.stacked_score_traj), so valid scores and the
+    per-iteration trajectory match k train_one_iter calls exactly, and
+    engine.train's block dispatch early-stops identically to the
+    per-iteration loop (reference eval cadence, gbdt.cpp:469-572)."""
+
+    def _mxu_booster(self, X, y, Xv, yv, extra=None):
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 31})
+        bst = lgb.Booster(params={**PARAMS, **(extra or {})},
+                          train_set=ds)
+        bst.add_valid(lgb.Dataset(Xv, label=yv), "v")
+        bst.update()
+        g = bst.gbdt
+        g._hist_impl = "mxu"
+        g._mxu_interpret = True
+        g._fused_run = None
+        return bst
+
+    def test_valid_scores_and_trajectory_match_per_iteration(self):
+        X, y = _data(seed=11)
+        Xv, yv = _data(n=200, seed=12)
+        a = self._mxu_booster(X, y, Xv, yv)
+        b = self._mxu_booster(X, y, Xv, yv)
+        assert a.gbdt._fused_eligible()
+        a.update_batch(3)
+        traj = a.gbdt._fused_valid_traj
+        assert traj is not None and len(traj) == 1
+        assert traj[0].shape[0] == 3
+        per_iter = []
+        for _ in range(3):
+            b.update()
+            per_iter.append(np.asarray(b.gbdt.valid_scores[0]).copy())
+        assert a.current_iteration() == b.current_iteration() == 4
+        assert a.model_to_string() == b.model_to_string()
+        # final valid scores agree, and every trajectory point equals
+        # the per-iteration valid score at that iteration
+        np.testing.assert_array_equal(
+            np.asarray(a.gbdt.valid_scores[0]), per_iter[-1])
+        for j in range(3):
+            np.testing.assert_array_equal(
+                np.asarray(traj[0][j]), per_iter[j], err_msg=f"iter {j}")
+
+    def test_engine_block_early_stopping_matches_per_iteration(
+            self, monkeypatch):
+        from lightgbm_tpu import engine as engine_mod
+
+        class _MxuBooster(lgb.Booster):
+            def __init__(self, *args, **kw):
+                super().__init__(*args, **kw)
+                self.gbdt._hist_impl = "mxu"
+                self.gbdt._mxu_interpret = True
+
+        monkeypatch.setattr(engine_mod, "Booster", _MxuBooster)
+        X, y = _data(seed=13)
+        rng = np.random.RandomState(14)
+        Xv = rng.randn(200, 5).astype(np.float32)
+        yv = (Xv[:, 0] + 1.5 * rng.randn(200) > 0).astype(np.float32)
+        results = []
+        for block in (1, 5):
+            bst = engine_mod.train(
+                {**PARAMS, "early_stopping_round": 2,
+                 "fused_block_size": block},
+                lgb.Dataset(X, label=y, params={"max_bin": 31}),
+                num_boost_round=25,
+                valid_sets=[lgb.Dataset(Xv, label=yv)])
+            results.append(bst)
+        a, b = results
+        assert a.best_iteration == b.best_iteration
+        assert a.current_iteration() == b.current_iteration()
+        assert dict(a.best_score) == dict(b.best_score)
+        # identical models modulo the serialized fused_block_size param
+        # itself (dispatch granularity is config, not model content)
+        strip = lambda s: [ln for ln in s.splitlines()
+                           if not ln.startswith("[fused_block_size")]
+        assert strip(a.model_to_string()) == strip(b.model_to_string())
+        # the stop must have engaged before the full round budget,
+        # otherwise this test proves nothing about rollback
+        assert a.current_iteration() < 25
