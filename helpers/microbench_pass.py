@@ -11,15 +11,20 @@ counts and row blocks to separate:
 and times the sibling-reconstruction dot at f32-HIGHEST vs an exact
 split-bf16 2-pass formulation.
 
+All timings are CHAINED IN-JIT (k dependency-chained iterations per
+dispatch, long-minus-short differencing) — per-dispatch tunnel latency
+through the remoted accelerator is tens of ms and would swamp
+single-call numbers.
+
 Usage: python helpers/microbench_pass.py [sweep|recon|all]
 """
 
-import os
 import sys
 import time
 
 import numpy as np
 
+import os
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
@@ -32,31 +37,49 @@ BMAX = 256
 M_PAD = 896          # round_up(2*447-1+1, 128) at overshoot 1.75
 
 
-def timeit(fn, *args, reps=10, **kw):
-    out = fn(*args, **kw)
-    jax.tree_util.tree_map(lambda a: np.asarray(a).ravel()[:1], out)
-    t0 = time.time()
-    for _ in range(reps):
-        out = fn(*args, **kw)
-    jax.tree_util.tree_map(lambda a: np.asarray(a).ravel()[:1], out)
-    return (time.time() - t0) / reps
+def timeit_chained(body, carry0, reps=16):
+    """Per-iteration seconds of `body` (carry -> carry), timed as one
+    jitted fori_loop dispatch of 2+reps iterations minus one of 2."""
+
+    @jax.jit
+    def chain(c0, k):
+        return jax.lax.fori_loop(0, k, lambda i, c: body(c), c0)
+
+    def run(k):
+        out = chain(carry0, jnp.asarray(k, jnp.int32))
+        jax.tree_util.tree_map(lambda a: np.asarray(a).ravel()[:1], out)
+
+    run(2)  # compile + warm
+    best = np.inf
+    for _ in range(2):
+        t0 = time.time()
+        run(2 + reps)
+        dt_long = time.time() - t0
+        t0 = time.time()
+        run(2)
+        dt_short = time.time() - t0
+        best = min(best, (dt_long - dt_short) / reps)
+    return best
 
 
 def make_pass_state(sk, rng):
-    """Tables emulating a mid-tree pass: sk parents split last pass,
-    children carry kernel slots, rows sit in the parents."""
+    """Ping-pong tables: sk parents split into children that split
+    straight back, so EVERY chained iteration routes through a split
+    node (full decision math + slot pickup) and builds sk slots —
+    the steady-pass cost, not the settled-rows shortcut."""
     from lightgbm_tpu.learner.histogram_mxu import pack_route_tables
     m1 = M_PAD
     ids = np.arange(m1)
-    split = ids < sk
+    is_parent = ids < sk
+    is_child = (ids >= sk) & (ids < 3 * sk)
+    split = is_parent | is_child
     feat = ids % F
     thr = np.full(m1, 128)
-    child_l = np.where(split, sk + 2 * ids, -1)
-    child_r = np.where(split, sk + 2 * ids + 1, -1)
-    slot = np.full(m1, -1)
-    child_ids = ids - sk
-    is_child = (ids >= sk) & (ids < 3 * sk)
-    slot[is_child] = child_ids[is_child] % sk
+    child_l = np.where(is_parent, sk + 2 * ids,
+                       np.where(is_child, (ids - sk) // 2, -1))
+    child_r = np.where(is_parent, sk + 2 * ids + 1,
+                       np.where(is_child, (ids - sk) // 2, -1))
+    slot = np.where(split, ids % sk, -1)
     tbl, member = pack_route_tables(
         jnp.asarray(split), jnp.asarray(feat, jnp.int32),
         jnp.asarray(thr, jnp.int32), jnp.zeros(m1, bool),
@@ -76,22 +99,31 @@ def bench_sweep():
     cnt = jnp.ones(N, jnp.float32)
     feat_tbl = jnp.stack([jnp.full(F, 255.0), jnp.zeros(F)], axis=1)
 
-    print("# fused_route_hist_mxu, quantized (3ch), m table rows below")
+    def _r128(x):
+        return min(M_PAD, ((x + 127) // 128) * 128)
+
+    print("# fused_route_hist_mxu per pass, quantized (3ch), chained")
     print("sk\trb\tm_cap\tms")
-    for sk in (2, 9, 16, 24, 40, 72, 136, 232):
+    # m_cap mirrors the grower's per-pass slice (round_up to lanes of
+    # the live node-id range); the sk=72 full-width row quantifies the
+    # table-width cost at mid frontier
+    for sk in (16, 72, 136, 232):
         tbl, member, row_node = make_pass_state(sk, rng)
-        for rb in (2048, 4096, 8192, 16384):
-            for m_cap in ({128, M_PAD} if sk <= 24 else {M_PAD}):
-                if 3 * sk > m_cap:
-                    continue
+        for rb in (2048, 4096, 8192):
+            for m_cap in ({_r128(3 * sk), M_PAD} if sk == 72 and
+                          rb == 2048 else {_r128(3 * sk)}):
                 t = tbl[:m_cap]
                 mem = member[:m_cap]
+
+                def body(rn):
+                    _h, rn2 = fused_route_hist_mxu(
+                        bins, g, h, cnt, rn, t, mem, feat_tbl,
+                        num_slots=sk, bmax=BMAX, has_cat=False,
+                        double_prec=True, quantized=True, row_block=rb)
+                    return rn2
+
                 try:
-                    dt = timeit(
-                        fused_route_hist_mxu, bins, g, h, cnt, row_node,
-                        t, mem, feat_tbl, num_slots=sk, bmax=BMAX,
-                        has_cat=False, double_prec=True, quantized=True,
-                        row_block=rb)
+                    dt = timeit_chained(body, row_node)
                 except Exception as e:
                     print(f"{sk}\t{rb}\t{m_cap}\tFAIL {type(e).__name__}")
                     continue
@@ -107,8 +139,7 @@ def bench_recon():
     mk = jnp.asarray(rng.randint(-1, 2, (s, sk)), jnp.float32)
     mp = jnp.asarray((rng.rand(s, p_all) < 0.01), jnp.float32)
 
-    @jax.jit
-    def recon_highest(mk, mp, kern2, parent):
+    def recon_highest(kern2):
         return jax.lax.dot_general(
             jnp.concatenate([mk, mp], axis=1),
             jnp.concatenate([kern2, parent], axis=0),
@@ -116,8 +147,7 @@ def bench_recon():
             precision=jax.lax.Precision.HIGHEST,
             preferred_element_type=jnp.float32)
 
-    @jax.jit
-    def recon_split(mk, mp, kern2, parent):
+    def recon_split(kern2):
         lhs = jnp.concatenate([mk, mp], axis=1).astype(jnp.bfloat16)
         rhs = jnp.concatenate([kern2, parent], axis=0)
         hi = jax.lax.reduce_precision(rhs, exponent_bits=8,
@@ -129,28 +159,28 @@ def bench_recon():
             preferred_element_type=jnp.float32)
         return d(hi) + d(lo)
 
-    a = timeit(recon_highest, mk, mp, kern2, parent)
-    b = timeit(recon_split, mk, mp, kern2, parent)
-    ra = np.asarray(recon_highest(mk, mp, kern2, parent))
-    rb = np.asarray(recon_split(mk, mp, kern2, parent))
+    a = timeit_chained(lambda k2: recon_highest(k2)[:sk], kern2,
+                       reps=300)
+    b = timeit_chained(lambda k2: recon_split(k2)[:sk], kern2,
+                       reps=300)
+    ra = np.asarray(recon_highest(kern2))
+    rb = np.asarray(recon_split(kern2))
     rel = np.abs(ra - rb).max() / max(np.abs(ra).max(), 1e-30)
-    print(f"# recon dot [s={s}, {sk}+{p_all}] x [{fb3}]")
+    print(f"# recon dot [s={s}, {sk}+{p_all}] x [{fb3}], chained")
     print(f"highest\t{a * 1e3:.2f} ms")
     print(f"split2\t{b * 1e3:.2f} ms\tmax rel diff {rel:.2e}")
 
-    # the parent-carry dot (sel_p), same shapes transposed
+    # the parent-carry dot (sel_p): [P, s] x [s, F*B*3]
     selp = jnp.asarray((rng.rand(p_all, s) < 0.004), jnp.float32)
     hist = jnp.asarray(rng.rand(s, fb3), jnp.float32)
 
-    @jax.jit
-    def carry_highest(selp, hist):
+    def carry_highest(hist):
         return jax.lax.dot_general(
             selp, hist, dimension_numbers=(((1,), (0,)), ((), ())),
             precision=jax.lax.Precision.HIGHEST,
             preferred_element_type=jnp.float32)
 
-    @jax.jit
-    def carry_split(selp, hist):
+    def carry_split(hist):
         hi = jax.lax.reduce_precision(hist, exponent_bits=8,
                                       mantissa_bits=7)
         sl = selp.astype(jnp.bfloat16)
@@ -160,8 +190,13 @@ def bench_recon():
             preferred_element_type=jnp.float32)
         return d(hi) + d(hist - hi)
 
-    a = timeit(carry_highest, selp, hist)
-    b = timeit(carry_split, selp, hist)
+    pad = jnp.zeros((s - p_all, fb3), jnp.float32)
+    a = timeit_chained(
+        lambda h_: jnp.concatenate([carry_highest(h_), pad]), hist,
+        reps=300)
+    b = timeit_chained(
+        lambda h_: jnp.concatenate([carry_split(h_), pad]), hist,
+        reps=300)
     print(f"carry_highest\t{a * 1e3:.2f} ms")
     print(f"carry_split\t{b * 1e3:.2f} ms")
 
