@@ -73,6 +73,51 @@ def run_mode(X, y, mode, n_trees):
     return n_trees / dt
 
 
+def run_reference(X, y, n_trees):
+    """Same-host reference binary at this shape, single core (VERDICT r4
+    item 3: the EFB story needs an external anchor, not just internal
+    A/Bs). Sparse LibSVM input (a dense 200k x 1000 CSV would be
+    ~800 MB); the reference's own EFB (enable_bundle) is on by default.
+    Trains twice (2 and n+2 iterations) so its loading/binning time
+    cancels out of the per-tree rate."""
+    import subprocess
+    import tempfile
+    bin_ = os.environ.get("LGBM_REFERENCE_BIN", "/tmp/lgbbuild/lightgbm")
+    if not os.path.exists(bin_):
+        print(f"# reference binary absent ({bin_}); skipping ref row")
+        return None
+    import shutil
+    d = tempfile.mkdtemp(prefix="efb_ref_")
+    path = os.path.join(d, "train.svm")
+    with open(path, "w") as fh:
+        for i in range(X.shape[0]):
+            nz = np.nonzero(X[i])[0]
+            fh.write("%d %s\n" % (
+                int(y[i]),
+                " ".join("%d:%.6g" % (j, X[i, j]) for j in nz)))
+    times = {}
+    for iters in (2, n_trees + 2):
+        conf = os.path.join(d, f"train_{iters}.conf")
+        with open(conf, "w") as fh:
+            fh.write(f"task=train\ndata={path}\nobjective=binary\n"
+                     f"num_iterations={iters}\nnum_leaves=63\n"
+                     "max_bin=63\nlearning_rate=0.1\n"
+                     "min_data_in_leaf=20\nnum_threads=1\nverbosity=-1\n"
+                     f"output_model={d}/m{iters}.txt\n")
+        t0 = time.time()
+        res = subprocess.run([bin_, f"config={conf}"],
+                             capture_output=True, text=True, timeout=7200)
+        assert res.returncode == 0, \
+            res.stdout[-2000:] + res.stderr[-2000:]
+        times[iters] = time.time() - t0
+    shutil.rmtree(d, ignore_errors=True)
+    rate = n_trees / max(times[n_trees + 2] - times[2], 1e-9)
+    print(f"reference impl=1-core   {n_trees} trees in "
+          f"{times[n_trees + 2] - times[2]:7.1f}s = {rate:5.3f} trees/s "
+          f"(loading/binning {times[2]:.0f}s excluded)", flush=True)
+    return rate
+
+
 def main():
     n_trees = int(sys.argv[1]) if len(sys.argv) > 1 else 10
     card = int(os.environ.get("EFB_CARD", 0))
@@ -80,10 +125,18 @@ def main():
     X, y = make_sparse(card=card)
     rates = {}
     for mode in modes:
+        if mode == "ref":
+            r = run_reference(X, y, n_trees)
+            if r:
+                rates[mode] = r
+            continue
         rates[mode] = run_mode(X, y, mode, n_trees)
     if "seg" in rates and "portable" in rates:
         print(f"# card={card}: segmented-MXU / portable speedup: "
               f"{rates['seg'] / rates['portable']:.2f}x")
+    if "ref" in rates and "portable" in rates:
+        print(f"# card={card}: ours-portable / reference-1-core: "
+              f"{rates['portable'] / rates['ref']:.2f}x")
 
 
 if __name__ == "__main__":

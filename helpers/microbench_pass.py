@@ -16,7 +16,7 @@ dispatch, long-minus-short differencing) — per-dispatch tunnel latency
 through the remoted accelerator is tens of ms and would swamp
 single-call numbers.
 
-Usage: python helpers/microbench_pass.py [sweep|recon|all]
+Usage: python helpers/microbench_pass.py [sweep|recon|tree|all]
 """
 
 import sys
@@ -207,3 +207,44 @@ if __name__ == "__main__":
         bench_sweep()
     if which in ("recon", "all"):
         bench_recon()
+
+
+def bench_tree():
+    """Chained whole-tree growth on the REAL bench data/config —
+    separates the grower's cost from the boosting ring's (grad/quantize/
+    score/stacking glue): ring = fused-block per-tree minus this."""
+    sys.path.insert(0, REPO)
+    from bench import make_higgs_like, PARAMS, MAX_BIN, N_FEATURES
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.learner.grower_mxu import grow_tree_mxu
+
+    X, y = make_higgs_like(N, N_FEATURES)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": MAX_BIN})
+    bst = lgb.Booster(params=dict(PARAMS), train_set=ds)
+    g = bst.gbdt
+    kw = g._mxu_grow_kwargs()
+    print("# grower kwargs:", {k: v for k, v in kw.items()
+                               if not hasattr(v, "shape")})
+    yd = jnp.asarray(y)
+    p = jnp.float32(0.5)
+    grad0 = p - yd
+    hess0 = jnp.full(N, 0.25, jnp.float32)
+    cnt = jnp.ones(N, jnp.float32)
+    fmask = jnp.ones(N_FEATURES, jnp.float32)
+    key = jax.random.PRNGKey(3)
+
+    def body(rn):
+        # dependency chain without changing the data: 0*rn is not
+        # foldable for floats per IEEE (rn is int -> cast first)
+        g_in = grad0 + 0.0 * rn.astype(jnp.float32)
+        tree, rn2 = grow_tree_mxu(
+            g.bins, g_in, hess0, cnt, fmask, g.num_bins_d,
+            g.missing_is_nan_d, g.is_cat_d, rng_key=key, **kw)
+        return rn2
+
+    dt = timeit_chained(body, jnp.zeros(N, jnp.int32), reps=10)
+    print(f"whole-tree growth (chained): {dt * 1e3:.1f} ms/tree")
+
+
+if __name__ == "__main__" and "tree" in sys.argv[1:]:
+    bench_tree()

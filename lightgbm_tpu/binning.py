@@ -405,20 +405,24 @@ def find_bin_mappers(X: np.ndarray, max_bin: int = 255,
     is cheap enough to run redundantly on each host, keeping mappers
     identical by construction.
     """
+    from .utils.timer import global_timer
     num_data, num_features = X.shape
     cat_set = set(categorical_features or [])
-    if num_data > sample_cnt:
-        rng = np.random.RandomState(seed)
-        idx = rng.choice(num_data, size=sample_cnt, replace=False)
-        sample = X[np.sort(idx)]
-        total = sample_cnt
-    else:
-        sample = X
-        total = num_data
-    # transpose once: per-feature slices become contiguous, which makes
-    # the per-column mask/filter/sort work ~5x faster than strided views
-    # (transpose + dtype conversion fused into a single allocation)
-    sample_t = np.ascontiguousarray(np.asarray(sample).T, dtype=np.float64)
+    with global_timer.timeit("dataset_sample"):
+        if num_data > sample_cnt:
+            rng = np.random.RandomState(seed)
+            idx = rng.choice(num_data, size=sample_cnt, replace=False)
+            sample = X[np.sort(idx)]
+            total = sample_cnt
+        else:
+            sample = X
+            total = num_data
+        # transpose once: per-feature slices become contiguous, which
+        # makes the per-column mask/filter/sort work ~5x faster than
+        # strided views (transpose + dtype conversion fused into a
+        # single allocation)
+        sample_t = np.ascontiguousarray(np.asarray(sample).T,
+                                        dtype=np.float64)
     from . import cext
     numeric = [f for f in range(num_features) if f not in cat_set]
     if cext.available() and numeric:
@@ -426,8 +430,10 @@ def find_bin_mappers(X: np.ndarray, max_bin: int = 255,
         # lgbt_find_numeric_bounds, the reference's OMP FindBin loop,
         # dataset_loader.cpp:~690); behavior-exact vs the NumPy path
         sub = sample_t[numeric] if cat_set else sample_t
-        blist, mtype, minmax, zero_na = cext.find_numeric_bounds(
-            sub, max_bin, min_data_in_bin, use_missing, zero_as_missing)
+        with global_timer.timeit("dataset_bounds"):
+            blist, mtype, minmax, zero_na = cext.find_numeric_bounds(
+                sub, max_bin, min_data_in_bin, use_missing,
+                zero_as_missing)
         mappers: List[BinMapper] = [None] * num_features  # type: ignore
         for j, fi in enumerate(numeric):
             mappers[fi] = BinMapper._from_native(

@@ -185,7 +185,9 @@ class BinnedDataset:
             all_mappers, feature_pre_filter and
             (mappers is None or pre_filter_with_mappers))
         from .binning import bin_columns
-        binned = bin_columns(X, used, used_mappers, dtype)
+        from .utils.timer import global_timer
+        with global_timer.timeit("dataset_quantize"):
+            binned = bin_columns(X, used, used_mappers, dtype)
         raw = np.ascontiguousarray(
             X[:, used], dtype=np.float32) if keep_raw else None
         return BinnedDataset(binned, used_mappers, used, num_total, metadata,

@@ -387,9 +387,23 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # adaptive blocks measured WORSE (0.059 vs 0.182 trees/s on the
         # low-cardinality shape, docs/PerfNotes.md round 4)
         rw = f if (efb is not None and not efb_seg) else 0
-        rb = (1024 if efb is not None else
-              (int(os.environ.get("LGBM_TPU_RB_SMALL", 2048))
-               if nslots <= 64 else 4096))
+        if efb is not None:
+            rb = 1024
+        elif nslots <= 64:
+            rb = int(os.environ.get("LGBM_TPU_RB_SMALL", 2048))
+        else:
+            # large frontiers: the chained per-pass microbench
+            # (helpers/microbench_pass.py, v5e round 5) measured 8192
+            # fastest at every sk > 64 (sk=72: 20.0 ms vs 26.9 at 4096;
+            # sk=136: 34.6 vs 38.9) — fewer grid steps re-visiting the
+            # VMEM-resident accumulator. Fall back block-by-block when
+            # the bigger input working set would bust the VMEM budget
+            # (e.g. 5-channel exact grads at wide frontiers).
+            for rb in (int(os.environ.get("LGBM_TPU_RB_LARGE", 8192)),
+                       4096, 2048):
+                if fits_v2(nslots, fk, bk, hist_double_prec, quant,
+                           route_width=rw, row_block=rb):
+                    break
         if fits_v2(nslots, fk, bk, hist_double_prec, quant,
                    route_width=rw, row_block=rb):
             h, rn = fused_route_hist_mxu(
