@@ -968,7 +968,7 @@ def _route_kernel(nb: int, f: int, m: int, bpad: int,
                               "interpret"))
 def route_rows_mxu(bins: jax.Array, row_node: jax.Array, tbl: jax.Array,
                    member: jax.Array, feat_tbl: jax.Array, *,
-                   row_block: int = 1024, num_features: int = 0,
+                   row_block: int = 0, num_features: int = 0,
                    loc_table=None, efb_range: bool = False,
                    interpret: bool = False):
     """Advance rows one level and emit (new row_node, new row_slot).
@@ -986,8 +986,20 @@ def route_rows_mxu(bins: jax.Array, row_node: jax.Array, tbl: jax.Array,
     f = num_features if num_features else fcols
     f_route = loc_table.shape[0] if has_efb else f
     fh = fcols if num_features else 0
-    nb = row_block
     m, kcols = tbl.shape
+    # row_block 0 = auto: 4096 measured fastest at the flagship shape
+    # (6.6 vs 8.0 ms at m=896, docs/PerfNotes.md round 5), but ONLY for
+    # narrow-input dense routing — wide tables ([nb, m] one-hot), wide
+    # bins blocks, and both EFB modes (the expansion decode OOM'd at a
+    # 2048 block on 250-column bundles, grower_mxu.py sweep note) keep
+    # the conservative 1024
+    if row_block:
+        nb = row_block
+    elif m <= 2048 and fcols <= 128 and loc_table is None \
+            and not efb_range:
+        nb = 4096
+    else:
+        nb = 1024
     bpad = member.shape[1]
     npad = (-n) % nb
     if npad:
@@ -1107,13 +1119,18 @@ def _values_kernel(nb: int, m: int):
 
 @functools.partial(jax.jit, static_argnames=("row_block", "interpret"))
 def node_values_mxu(row_node: jax.Array, values: jax.Array, *,
-                    row_block: int = 2048,
+                    row_block: int = 0,
                     interpret: bool = False) -> jax.Array:
     """values[row_node] without a gather: [N] <- [M] table via one-hot
-    matmul (score updates, reference score_updater.hpp:21-110)."""
+    matmul (score updates, reference score_updater.hpp:21-110).
+    row_block 0 = auto: 8192 measured fastest at the common table sizes
+    (3.0 vs 4.4 ms at m=896, docs/PerfNotes.md round 5); narrower for
+    very wide tables (the [nb, m] f32 one-hot lives in VMEM)."""
     n = row_node.shape[0]
     m1 = values.shape[0]
     m = _round_up(m1, 128)
+    if not row_block:
+        row_block = 8192 if m <= 1024 else 2048
     # unlike a gather, the one-hot contraction touches EVERY table entry
     # (0 * NaN = NaN would poison all rows); never-referenced rows such as
     # the grower's scratch node can hold NaN, so sanitize first
