@@ -57,7 +57,9 @@ def make_regression(n, seed):
 def make_multiclass(n, seed, k=5):
     rng = np.random.RandomState(seed)
     X = rng.randn(n, F).astype(np.float32)
-    centers = rng.randn(k, 6) * 1.2
+    # class geometry must be seed-INDEPENDENT so train and held-out
+    # splits share one distribution (only the rows/noise vary by seed)
+    centers = np.random.RandomState(7).randn(k, 6) * 1.2
     d = ((X[:, None, :6] - centers[None]) ** 2).sum(-1)
     d += 1.5 * rng.gumbel(size=(n, k))
     y = np.argmin(d, axis=1).astype(np.float32)
@@ -214,11 +216,13 @@ def run_reference(task, n_trees):
 
 
 def main():
-    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    argv = sys.argv[1:]
     n_trees = 100
-    if "--trees" in sys.argv:
-        n_trees = int(sys.argv[sys.argv.index("--trees") + 1])
-    tasks = args or list(TASKS)
+    if "--trees" in argv:
+        i = argv.index("--trees")
+        n_trees = int(argv[i + 1])
+        argv = argv[:i] + argv[i + 2:]
+    tasks = [a for a in argv if not a.startswith("--")] or list(TASKS)
     for task in tasks:
         run_ours(task, n_trees)
         run_reference(task, n_trees)

@@ -486,7 +486,14 @@ class GBDT:
                 hist_subtraction=cfg.hist_subtraction,
                 overshoot=cfg.growth_overshoot,
                 bridge_gate=cfg.growth_bridge_gate,
-                quantized_grad=cfg.use_quantized_grad))
+                quantized_grad=cfg.use_quantized_grad,
+                # const-hessian stays OFF for the sharded learner: its
+                # kwargs are baked here, BEFORE objective.init() binds
+                # sample weights, so the _const_hessian() gate cannot
+                # be evaluated safely yet (a weighted dataset would get
+                # the fast path wrongly enabled and train silently
+                # wrong hessians)
+                const_hessian=0.0))
         Log.info("Distributed learner: %s-parallel over %d devices%s",
                  self.comm.mode, ndev, " (mxu)" if use_mxu else "")
 
@@ -542,6 +549,23 @@ class GBDT:
         f = int(self.num_bins_d.shape[0])
         return s_max * f * self.bmax * 3 * 4
 
+    def _const_hessian(self) -> float:
+        """Constant-hessian fast-path gate (reference IsConstantHessian,
+        objective_function.h:42): per-row hessians are exactly 1 x the
+        count weight, so the kernels can drop the hessian channel and
+        reconstruct it as the count — one fewer histogram dot channel
+        and exact hessian sums. GOSS re-weights hessians independently
+        of the count channel (amplified rows count 1), and user weights
+        ride the hessian but not cnt_weight — both break the
+        h == const x cnt identity, so they gate it off. Bagging keeps
+        it (the mask scales hessian AND count identically). Must be
+        evaluated AFTER objective.init() has bound weights."""
+        return 1.0 if (
+            self.objective is not None and
+            getattr(self.objective, "is_constant_hessian", False) and
+            getattr(self.objective, "weight", None) is None and
+            self.config.boosting != "goss") else 0.0
+
     def _mxu_grow_kwargs(self):
         """Static grow_tree_mxu settings — single source shared by the
         per-iteration path (_grow) and the fused scan (_build_fused) so
@@ -549,6 +573,7 @@ class GBDT:
         cfg = self.config
         return dict(
             efb=self._efb, forced=self._forced, cegb_cfg=self._cegb_cfg,
+            const_hessian=self._const_hessian(),
             num_leaves=cfg.num_leaves, max_depth=cfg.max_depth,
             hp=self.hp, bmax=self.bmax, monotone=self._monotone,
             interaction_groups=self._interaction_groups,

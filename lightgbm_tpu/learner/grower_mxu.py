@@ -179,6 +179,7 @@ def _kernel_cap(s: int) -> int:
                      "hist_subtraction", "overshoot", "bridge_gate",
                      "psum_axis",
                      "quantized_grad", "use_scan_kernel", "packed4",
+                     "const_hessian",
                      "cegb_cfg", "debug_info"))
 def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   cnt_weight: jax.Array, feature_mask: jax.Array,
@@ -199,6 +200,7 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   quantized_grad: bool = False,
                   use_scan_kernel: bool = False,
                   packed4: bool = False,
+                  const_hessian: float = 0.0,
                   efb=None,
                   forced=None,
                   cegb_cfg=None,
@@ -277,6 +279,13 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # double-bf16 scheme); the final leaf values are recomputed exactly
     # at the end, so quantization only perturbs the split SEARCH.
     quant = quantized_grad
+    # const_hessian != 0: per-row hessians are const x cnt_weight (the
+    # reference's IsConstantHessian fast path) — the kernels drop the
+    # hessian channel and reconstruct it exactly as const x count, so
+    # hessian sums carry NO quantization noise and every histogram dot
+    # runs one channel lighter (3 -> 2 quantized, 5 -> 3 exact)
+    ch = const_hessian
+    root_c = _allred(jnp.sum(cnt_weight))
     if quant:
         qkey = rng_key if rng_key is not None else jax.random.PRNGKey(0)
         qkey = jax.random.fold_in(qkey, 6271)
@@ -287,17 +296,20 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         qkey = jax.random.fold_in(
             qkey, jax.lax.bitcast_convert_type(jnp.sum(grad), jnp.int32))
         h_grad, h_hess, gscale, hscale = quantize_gradients(
-            grad, hess, qkey, pmax_axis=psum_axis)
+            grad, None if ch else hess, qkey, pmax_axis=psum_axis)
+        if h_hess is None:
+            h_hess = hess  # never read: the channel builder drops it
         hist_scale = jnp.stack([gscale, hscale, jnp.float32(1.0)])
         # hist-consistent root sums (exact integer sums x scale), so
         # right-child = parent - left stays internally consistent
         root_g = _allred(jnp.sum(h_grad)) * gscale
-        root_h = _allred(jnp.sum(h_hess)) * hscale
+        root_h = jnp.float32(ch) * root_c if ch else \
+            _allred(jnp.sum(h_hess)) * hscale
     else:
         h_grad, h_hess = grad, hess
         root_g = _allred(jnp.sum(grad))
-        root_h = _allred(jnp.sum(hess))
-    root_c = _allred(jnp.sum(cnt_weight))
+        root_h = jnp.float32(ch) * root_c if ch else \
+            _allred(jnp.sum(hess))
     root_val = leaf_output(root_g, root_h, hp.lambda_l1, hp.lambda_l2,
                            hp.max_delta_step)
     tree0 = _init_tree(m, root_g, root_h, root_c, root_val,
@@ -402,17 +414,17 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             for rb in (int(os.environ.get("LGBM_TPU_RB_LARGE", 8192)),
                        4096, 2048):
                 if fits_v2(nslots, fk, bk, hist_double_prec, quant,
-                           route_width=rw, row_block=rb):
+                           route_width=rw, row_block=rb, const_hess=ch):
                     break
         if fits_v2(nslots, fk, bk, hist_double_prec, quant,
-                   route_width=rw, row_block=rb):
+                   route_width=rw, row_block=rb, const_hess=ch):
             h, rn = fused_route_hist_mxu(
                 bins, h_grad, h_hess, cnt_weight, row_node, tbl_c,
                 member_c, feat_tbl, num_slots=nslots, bmax=bk,
                 has_cat=hp.has_categorical, quantized=quant,
                 double_prec=hist_double_prec, num_features=nf_packed,
                 loc_table=None if efb_seg else loc_tbl,
-                efb_range=efb_seg, row_block=rb,
+                efb_range=efb_seg, row_block=rb, const_hess=ch,
                 interpret=interpret)
         else:
             rn, rs = route_rows_mxu(bins, row_node, tbl_c, member_c,
@@ -424,6 +436,7 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 bins, h_grad, h_hess, cnt_weight, rs, num_slots=nslots,
                 bmax=bk, interpret=interpret, quantized=quant,
                 double_prec=hist_double_prec, num_features=nf_packed,
+                const_hess=ch,
                 **hist_cfg(nslots))
         if quant:
             h = h * hist_scale  # integer sums -> gradient units

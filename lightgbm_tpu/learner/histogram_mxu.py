@@ -160,7 +160,7 @@ def _hist_kernel(nb: int, fc: int, b: int, s: int, flane: int,
 
 
 def _hist_channels(grad, hess, cnt, double_prec: bool,
-                   quantized: bool = False):
+                   quantized: bool = False, const_hess: float = 0.0):
     """Channel matrix [N, 8] for the histogram kernels (hi/lo bf16 pairs
     + count, or grad-hi/lo + single-bf16 hessian + count).
 
@@ -169,9 +169,27 @@ def _hist_channels(grad, hess, cnt, double_prec: bool,
     so each rides a single channel with no hi/lo split: 3 channels
     instead of 5, the flop lever of quantized GBDT training adapted to
     the MXU formulation. f32 accumulation is integer-exact to 2^24 and
-    ~1e-7-relative beyond, far inside the stochastic-rounding noise."""
+    ~1e-7-relative beyond, far inside the stochastic-rounding noise.
+
+    const_hess != 0 drops the hessian channel entirely (the reference's
+    IsConstantHessian fast path, objective_function.h:42): per-row
+    hessians are const_hess x the count weight, so the hessian histogram
+    is reconstructed as const_hess * count in _combine_hist — EXACT (no
+    quantization noise on hessians) and one fewer MXU channel
+    (quantized 3 -> 2, exact 5 -> 3)."""
     g = grad.astype(jnp.float32)
     h = hess.astype(jnp.float32)
+    if const_hess:
+        if quantized:
+            chans = [g, cnt.astype(jnp.float32)]
+        else:
+            g_hi = jax.lax.reduce_precision(g, exponent_bits=8,
+                                            mantissa_bits=7)
+            chans = [g_hi, g - g_hi, cnt.astype(jnp.float32)]
+        nchan = len(chans)
+        data = jnp.stack(chans + [jnp.zeros_like(g)] * (8 - nchan),
+                         axis=1)
+        return data, nchan
     if quantized:
         chans = [g, h, cnt.astype(jnp.float32)]
         data = jnp.stack(chans + [jnp.zeros_like(g)] * 5, axis=1)
@@ -199,35 +217,50 @@ def quantize_gradients(grad, hess, key, *, pmax_axis=None):
     hessians). Unbiased (E[g_q]*gs = g); per-tree scales. Returns
     (g_q, h_q, gscale, hscale) with g_q/h_q integer-valued f32.
 
+    hess=None (the constant-hessian fast path): skip hessian
+    quantization entirely — returns (g_q, None, gscale, 1.0), saving
+    the hessian PRNG draw and keeping hessian sums exact.
+
     pmax_axis: shard_map axis name for distributed training — scales must
     agree across shards so every rank bins identical integers."""
     g = grad.astype(jnp.float32)
-    h = hess.astype(jnp.float32)
     gmax = jnp.max(jnp.abs(g))
+    if pmax_axis:
+        gmax = jax.lax.pmax(gmax, pmax_axis)
+    gscale = jnp.maximum(gmax, 1e-30) / 127.0
+    ku, kv = jax.random.split(key)
+    ug = jax.random.uniform(ku, g.shape)
+    # clip: f32 rounding at the band edge (127 + u -> 128.0) can escape
+    # the documented [-127, 127] contract a few times per billion rows
+    g_q = jnp.clip(jnp.floor(g / gscale + ug), -127.0, 127.0)
+    if hess is None:
+        return g_q, None, gscale, jnp.float32(1.0)
+    h = hess.astype(jnp.float32)
     # abs: custom objectives may hand back negative hessians; scaling by
     # max|h| keeps h_q inside the bf16-exact [-127, 127] band either way
     hmax = jnp.max(jnp.abs(h))
     if pmax_axis:
-        gmax = jax.lax.pmax(gmax, pmax_axis)
         hmax = jax.lax.pmax(hmax, pmax_axis)
-    gscale = jnp.maximum(gmax, 1e-30) / 127.0
     hscale = jnp.maximum(hmax, 1e-30) / 127.0
-    ku, kv = jax.random.split(key)
-    ug = jax.random.uniform(ku, g.shape)
     uh = jax.random.uniform(kv, h.shape)
-    # clip: f32 rounding at the band edge (127 + u -> 128.0) can escape
-    # the documented [-127, 127] contract a few times per billion rows
-    g_q = jnp.clip(jnp.floor(g / gscale + ug), -127.0, 127.0)
     h_q = jnp.clip(jnp.floor(h / hscale + uh), -127.0, 127.0)
     return g_q, h_q, gscale, hscale
 
 
 def _combine_hist(out, *, nchan: int, s: int, f: int, b: int, bmax: int,
-                  double_prec: bool) -> jax.Array:
+                  double_prec: bool, const_hess: float = 0.0) -> jax.Array:
     """Kernel output [*, nchan*s, f*b] -> [S, F, bmax, 3] with the hi/lo
-    channel recombination (shared postlude of the v2/fused kernels)."""
+    channel recombination (shared postlude of the v2/fused kernels).
+    const_hess != 0: the hessian channel was dropped by _hist_channels;
+    reconstruct it exactly as const_hess * count."""
     out = out.reshape(nchan, s, f, b)[..., :bmax]
     out = jnp.transpose(out, (1, 0, 2, 3))                   # [S, C, F, B]
+    if const_hess:
+        if nchan == 2:   # quantized: [g_int, cnt]
+            g, c = out[:, 0], out[:, 1]
+        else:            # exact: [g_hi, g_lo, cnt]
+            g, c = out[:, 0] + out[:, 1], out[:, 2]
+        return jnp.stack([g, c * jnp.float32(const_hess), c], axis=-1)
     if nchan == 3:  # quantized: integer g/h sums ride single channels
         return jnp.stack([out[:, 0], out[:, 1], out[:, 2]], axis=-1)
     if double_prec:
@@ -418,12 +451,13 @@ def _hist_kernel_v2(nb: int, f: int, b: int, s: int,
 @functools.partial(
     jax.jit, static_argnames=("num_slots", "bmax", "row_block", "fchunk",
                               "interpret", "use_f32", "double_prec",
-                              "quantized"))
+                              "quantized", "const_hess"))
 def build_histograms_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                          cnt: jax.Array, row_slot: jax.Array, *,
                          num_slots: int, bmax: int, row_block: int = 1024,
                          fchunk: int = 4, use_f32: bool = False,
                          double_prec: bool = True, quantized: bool = False,
+                         const_hess: float = 0.0,
                          interpret: bool = False) -> jax.Array:
     """Per-slot histograms without sorting or gathering.
 
@@ -458,7 +492,8 @@ def build_histograms_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     if npad:
         slot = jnp.pad(slot, (0, npad), constant_values=-1)
 
-    data, nchan = _hist_channels(grad, hess, cnt, double_prec, quantized)
+    data, nchan = _hist_channels(grad, hess, cnt, double_prec, quantized,
+                                 const_hess)
     if npad:
         data = jnp.pad(data, ((0, npad), (0, 0)))
 
@@ -490,7 +525,13 @@ def build_histograms_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     out = out.reshape(nchunks, nchan, s, fc, b)
     out = jnp.transpose(out, (2, 1, 0, 3, 4)).reshape(s, nchan, fpad, b)
     out = out[:, :, :f, :bmax]
-    if nchan == 3:
+    if const_hess:
+        if nchan == 2:
+            g, c = out[:, 0], out[:, 1]
+        else:
+            g, c = out[:, 0] + out[:, 1], out[:, 2]
+        hist = jnp.stack([g, c * jnp.float32(const_hess), c], axis=-1)
+    elif nchan == 3:
         hist = jnp.stack([out[:, 0], out[:, 1], out[:, 2]], axis=-1)
     elif double_prec:
         hist = jnp.stack([out[:, 0] + out[:, 1], out[:, 2] + out[:, 3],
@@ -514,7 +555,8 @@ _V2_ROW_BLOCK = 4096  # worst-case block the grower/dispatcher may pick
 def fits_v2(num_slots: int, num_features: int, bmax: int,
             double_prec: bool = True, quantized: bool = False,
             route_width: int = 0,
-            row_block: int = _V2_ROW_BLOCK) -> bool:
+            row_block: int = _V2_ROW_BLOCK,
+            const_hess: float = 0.0) -> bool:
     """Whether the extraction-free v2/fused kernels' working set fits
     the VMEM budget for this shape (single owner of the predicate — the
     grower and the auto dispatcher must agree). route_width: the
@@ -523,7 +565,12 @@ def fits_v2(num_slots: int, num_features: int, bmax: int,
     one-hots + the loc_table decode); row_block: the block the caller
     will actually use."""
     b = ((bmax + 127) // 128) * 128
-    nchan = 3 if quantized else (5 if double_prec else 4)
+    if const_hess:
+        # _hist_channels: [g, cnt] quantized, [g_hi, g_lo, cnt] exact
+        # (regardless of double_prec — the dropped channel is hessian)
+        nchan = 2 if quantized else 3
+    else:
+        nchan = 3 if quantized else (5 if double_prec else 4)
     out = nchan * num_slots * num_features * b * 4
     plane = ((num_features + 127) // 128) * 128
     flane_r = ((max(route_width, num_features) + 127) // 128) * 128
@@ -540,7 +587,7 @@ def fits_v2(num_slots: int, num_features: int, bmax: int,
 @functools.partial(
     jax.jit, static_argnames=("num_slots", "bmax", "row_block",
                               "interpret", "use_f32", "double_prec",
-                              "quantized", "num_features"))
+                              "quantized", "num_features", "const_hess"))
 def build_histograms_mxu_v2(bins: jax.Array, grad: jax.Array,
                             hess: jax.Array, cnt: jax.Array,
                             row_slot: jax.Array, *, num_slots: int,
@@ -549,6 +596,7 @@ def build_histograms_mxu_v2(bins: jax.Array, grad: jax.Array,
                             double_prec: bool = True,
                             quantized: bool = False,
                             num_features: int = 0,
+                            const_hess: float = 0.0,
                             interpret: bool = False) -> jax.Array:
     """Extraction-free variant of build_histograms_mxu (same contract):
     one grid pass over rows, per-feature static lane slices instead of
@@ -576,7 +624,8 @@ def build_histograms_mxu_v2(bins: jax.Array, grad: jax.Array,
         .astype(jnp.int32)
     if npad:
         slot = jnp.pad(slot, (0, npad), constant_values=-1)
-    data, nchan = _hist_channels(grad, hess, cnt, double_prec, quantized)
+    data, nchan = _hist_channels(grad, hess, cnt, double_prec, quantized,
+                                 const_hess)
     if npad:
         data = jnp.pad(data, ((0, npad), (0, 0)))
 
@@ -604,28 +653,32 @@ def build_histograms_mxu_v2(bins: jax.Array, grad: jax.Array,
     )(block_any, slot[:, None], bins, data)
 
     return _combine_hist(out, nchan=nchan, s=s, f=f, b=b, bmax=bmax,
-                         double_prec=double_prec)
+                         double_prec=double_prec, const_hess=const_hess)
 
 
 def build_histograms_mxu_auto(bins, grad, hess, cnt, row_slot, *,
                               num_slots, bmax, double_prec=True,
                               quantized=False, num_features=0,
+                              const_hess=0.0,
                               interpret=False, **v1_cfg):
     """v2 kernel when its per-feature output block fits VMEM, else the
     chunked v1 kernel (wide-feature datasets). num_features > 0 marks
     `bins` as 4-bit packed (the v1 fallback unpacks on device — packed
     storage targets small-bmax shapes, which always fit v2)."""
     f = num_features if num_features else bins.shape[1]
-    if fits_v2(num_slots, f, bmax, double_prec, quantized):
+    if fits_v2(num_slots, f, bmax, double_prec, quantized,
+               const_hess=const_hess):
         return build_histograms_mxu_v2(
             bins, grad, hess, cnt, row_slot, num_slots=num_slots,
             bmax=bmax, double_prec=double_prec, quantized=quantized,
-            num_features=num_features, interpret=interpret)
+            num_features=num_features, const_hess=const_hess,
+            interpret=interpret)
     if num_features:
         bins = unpack_bins_4bit(bins, num_features)
     return build_histograms_mxu(
         bins, grad, hess, cnt, row_slot, num_slots=num_slots, bmax=bmax,
-        double_prec=double_prec, quantized=quantized, interpret=interpret,
+        double_prec=double_prec, quantized=quantized,
+        const_hess=const_hess, interpret=interpret,
         **v1_cfg)
 
 
@@ -711,7 +764,7 @@ def _fused_kernel(nb: int, f: int, flane: int, b: int, s: int, m: int,
 @functools.partial(
     jax.jit, static_argnames=("num_slots", "bmax", "row_block", "has_cat",
                               "double_prec", "quantized", "num_features",
-                              "efb_range", "interpret"))
+                              "efb_range", "const_hess", "interpret"))
 def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                          cnt: jax.Array, row_node: jax.Array,
                          tbl: jax.Array, member: jax.Array,
@@ -720,6 +773,7 @@ def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                          double_prec: bool = True, quantized: bool = False,
                          num_features: int = 0, loc_table=None,
                          efb_range: bool = False,
+                         const_hess: float = 0.0,
                          interpret: bool = False):
     """One sweep: route rows through the previous pass's packed split
     tables (pack_route_tables) AND build the per-slot histograms of the
@@ -772,7 +826,8 @@ def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                        (0, bb_lane - loc_table.shape[1])))
     else:
         loc = jnp.zeros((8, 128), jnp.float32)  # unused placeholder
-    data, nchan = _hist_channels(grad, hess, cnt, double_prec, quantized)
+    data, nchan = _hist_channels(grad, hess, cnt, double_prec, quantized,
+                                 const_hess)
     if npad:
         data = jnp.pad(data, ((0, npad), (0, 0)))
 
@@ -805,7 +860,7 @@ def fused_route_hist_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
       feat_tbl, loc)
 
     h3 = _combine_hist(hist, nchan=nchan, s=s, f=f, b=b, bmax=bmax,
-                       double_prec=double_prec)
+                       double_prec=double_prec, const_hess=const_hess)
     return h3, node_out[:n, 0]
 
 

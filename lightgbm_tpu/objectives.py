@@ -366,6 +366,10 @@ class MulticlassSoftmax(ObjectiveFunction):
     def __init__(self, config: Config):
         super().__init__(config)
         self.num_class = int(config.num_class)
+        if self.num_class < 2:
+            raise ValueError(
+                "multiclass objective needs num_class >= 2 "
+                f"(got {self.num_class})")
         self.num_model_per_iteration = self.num_class
 
     def check_label(self):
@@ -383,14 +387,23 @@ class MulticlassSoftmax(ObjectiveFunction):
         """score: [N, num_class] -> grad/hess [N, num_class]."""
         p = jax.nn.softmax(score, axis=-1)
         grad = p - self.onehot
-        # factor 2 matches multiclass_objective.hpp:90-102
-        hess = 2.0 * p * (1.0 - p)
+        # hessian factor k/(k-1) (multiclass_objective.hpp:31,105) —
+        # 2.0 at k=2, 1.25 at k=5; a hardcoded 2 over-damps leaf values
+        # for k > 2 (round-5 task-matrix bench caught the gap)
+        factor = self.num_class / (self.num_class - 1.0)
+        hess = factor * p * (1.0 - p)
         if self.weight is not None:
             return grad * self.weight[:, None], hess * self.weight[:, None]
         return grad, hess
 
     def boost_from_score(self, class_id: int = 0) -> float:
-        return 0.0
+        # log class prior (multiclass_objective.hpp:155: log of the
+        # weighted class frequency, clamped at kEpsilon)
+        lbl = np.asarray(self.label).astype(np.int64)
+        w = np.asarray(self.weight, np.float64) \
+            if self.weight is not None else np.ones(len(lbl))
+        p = float(w[lbl == class_id].sum() / max(w.sum(), _EPS))
+        return float(np.log(max(1e-15, p)))
 
     def convert_output(self, raw):
         return jax.nn.softmax(raw, axis=-1)
