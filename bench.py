@@ -96,7 +96,9 @@ def _probe_with_retry() -> str:
 PARAMS = {"objective": "binary", "num_leaves": NUM_LEAVES,
           "learning_rate": 0.1, "max_bin": MAX_BIN, "verbosity": -1,
           "min_data_in_leaf": 20, "use_quantized_grad": True,
-          "growth_overshoot": 1.75, "growth_bridge_gate": 0.93}
+          "growth_overshoot": float(os.environ.get("BENCH_OVERSHOOT",
+                                                   1.75)),
+          "growth_bridge_gate": 0.93}
 # Bench posture vs library defaults (both A/B'd, docs/PerfNotes.md):
 # - use_quantized_grad: stochastically-rounded integer gradients with
 #   exact leaf refit. Round-3 A/B: 2.31 vs 1.74 trees/s, AUC@95
