@@ -144,16 +144,21 @@ def run_ours(task, n_trees):
     iters = max(1, n_trees // kcls)
     block = max(1, 20 // kcls)
     # warmup: iteration 0 (normal path) + one block compile — clamped so
-    # ours never trains more total trees than the reference row
+    # ours never trains more total trees than the reference row.
+    # bench._drain slices ON DEVICE before the host pull — a full
+    # np.asarray(train_score) would drag the whole [N, k] score through
+    # the tunnel per block (20 MB at 1M x 5; it depressed the first
+    # multiclass rows by ~25%, docs/PerfNotes.md round 5)
+    from bench import _drain
     bst.update_batch(min(1 + block, iters))
-    float(np.asarray(bst.gbdt.train_score).ravel()[0])
+    _drain(bst)
     done = min(1 + block, iters)
     rates = []
     while done < iters:
         step = min(block, iters - done)
         t1 = time.time()
         bst.update_batch(step)
-        float(np.asarray(bst.gbdt.train_score).ravel()[0])
+        _drain(bst)
         rates.append(step * kcls / (time.time() - t1))
         done += step
     pred = bst.predict(Xv, raw_score=True)
