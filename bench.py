@@ -23,8 +23,11 @@ NUM_LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
 MAX_BIN = int(os.environ.get("BENCH_MAX_BIN", 255))
 WARMUP_TREES = 5
 BENCH_TREES = int(os.environ.get("BENCH_TREES", 100))
-BLOCK_TREES = int(os.environ.get("BENCH_BLOCK_TREES", 20))  # r4 A/B:
-# 20-tree dispatches halve the host drains (median 2.87 vs 2.78-2.82)
+BLOCK_TREES = int(os.environ.get("BENCH_BLOCK_TREES", 25))  # r4 A/B:
+# 20-tree dispatches halve the host drains (median 2.87 vs 2.78-2.82);
+# r5 same-hour A/B: 25-tree blocks measure 3.04/3.04 vs 2.95/2.96 at
+# 20 — one fewer drain and block boundaries that straddle the
+# deterministic fast/slow tree bands (docs/PerfNotes.md round 5)
 BASELINE_TREES_PER_SEC = 500.0 / 130.094  # reference CPU Higgs headline
 # like-for-like anchor (VERDICT r4 weak #8): the reference binary on
 # THIS synthetic 1M x 28 set, single core, idle host — re-measured each

@@ -112,3 +112,29 @@ class TestConstHessian:
                           params={"max_bin": 31})
         bw = lgb.Booster(params=dict(params), train_set=dsw)
         assert bw.gbdt._mxu_grow_kwargs()["const_hessian"] == 0.0
+
+    def test_sharded_learner_keeps_const_hessian_off(self, monkeypatch):
+        # the sharded learner's mxu kwargs are baked before
+        # objective.init() binds weights, so the gate must stay OFF
+        # there (a weighted dataset would otherwise silently train
+        # wrong hessians — round-5 review finding)
+        import lightgbm_tpu.parallel.learner as plearner
+        captured = {}
+        orig = plearner.make_sharded_grower
+
+        def spy(*args, **kw):
+            captured.update(kw.get("mxu_kwargs") or {})
+            return orig(*args, **kw)
+
+        monkeypatch.setattr(plearner, "make_sharded_grower", spy)
+        X, y, _, _ = _reg_setup(seed=9)
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 31})
+        lgb.Booster(params={"objective": "regression", "num_leaves": 7,
+                            "verbosity": -1, "tree_learner": "data",
+                            "num_machines": 1, "use_quantized_grad": True},
+                    train_set=ds)
+        # the 8-virtual-device conftest guarantees the sharded path
+        # engages; an empty capture would mean the gate under test never
+        # ran — fail loudly rather than pass vacuously
+        assert captured, "sharded learner did not engage"
+        assert captured.get("const_hessian", 1.0) == 0.0

@@ -229,3 +229,38 @@ class TestFusedValidSets:
         # the stop must have engaged before the full round budget,
         # otherwise this test proves nothing about rollback
         assert a.current_iteration() < 25
+
+
+@pytest.mark.slow
+class TestEngineBlockGating:
+    def test_custom_callback_forces_per_iteration_cadence(
+            self, monkeypatch):
+        # a user callback that reads model state is NOT block_safe: the
+        # engine must fall back to per-iteration dispatch so the
+        # callback never observes future trees (round-5 review finding)
+        from lightgbm_tpu import engine as engine_mod
+
+        class _MxuBooster(lgb.Booster):
+            def __init__(self, *args, **kw):
+                super().__init__(*args, **kw)
+                self.gbdt._hist_impl = "mxu"
+                self.gbdt._mxu_interpret = True
+
+        monkeypatch.setattr(engine_mod, "Booster", _MxuBooster)
+        X, y = _data(seed=21)
+        Xv, yv = _data(n=150, seed=22)
+        seen = []
+
+        def snoop(env):
+            seen.append(env.model.current_iteration())
+
+        bst = engine_mod.train(
+            {**PARAMS, "fused_block_size": 4},
+            lgb.Dataset(X, label=y, params={"max_bin": 31}),
+            num_boost_round=6,
+            valid_sets=[lgb.Dataset(Xv, label=yv)],
+            callbacks=[snoop])
+        # per-iteration cadence: the callback saw every iteration count
+        # as it happened, never a block-end state at an inner iteration
+        assert seen == [1, 2, 3, 4, 5, 6]
+        assert bst.current_iteration() == 6
