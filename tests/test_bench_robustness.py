@@ -111,6 +111,10 @@ class TestBenchSurvivesFaults:
         parsed, err = _run_bench({_FAULT_ENV: "1"})
         assert parsed["metric"] == "higgs1m_trees_per_sec"
         assert parsed["value"] > 0, err[-2000:]
+        # the record schema is stable even on degraded runs: every key
+        # a round-over-round comparison indexes is present
+        for key in ("vs_baseline", "vs_single_core", "unit"):
+            assert key in parsed, key
 
     def test_fault_above_train_many_mid_measurement(self):
         # fault that escapes train_many: bench must re-probe, rebuild

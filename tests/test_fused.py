@@ -264,3 +264,45 @@ class TestEngineBlockGating:
         # as it happened, never a block-end state at an inner iteration
         assert seen == [1, 2, 3, 4, 5, 6]
         assert bst.current_iteration() == 6
+
+
+@pytest.mark.slow
+class TestFusedValidMulticlass:
+    def test_multiclass_valid_trajectory_matches_per_iteration(self):
+        # the stacked_score_traj num_class>1 branch: per-class column
+        # updates must reproduce k per-iteration valid updates exactly
+        rng = np.random.RandomState(31)
+        X = rng.randn(500, 5).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32) + (X[:, 1] > 0.5)
+        Xv = rng.randn(150, 5).astype(np.float32)
+        yv = (Xv[:, 0] > 0).astype(np.float32) + (Xv[:, 1] > 0.5)
+        boosters = []
+        for _ in range(2):
+            ds = lgb.Dataset(X, label=y, params={"max_bin": 31})
+            bst = lgb.Booster(params={**PARAMS, "objective": "multiclass",
+                                      "num_class": 3}, train_set=ds)
+            bst.add_valid(lgb.Dataset(Xv, label=yv), "v")
+            bst.update()
+            g = bst.gbdt
+            g._hist_impl = "mxu"
+            g._mxu_interpret = True
+            g._fused_run = None
+            boosters.append(bst)
+        a, b = boosters
+        assert a.gbdt._fused_eligible()
+        a.update_batch(2)
+        # pin that the FUSED dispatch actually ran — a silent
+        # per-iteration fallback would make this test pass vacuously
+        assert getattr(a.gbdt, "_fused_failures", 0) == 0
+        assert not getattr(a.gbdt, "_fused_disabled", False)
+        traj = a.gbdt._fused_valid_traj
+        assert traj is not None and traj[0].shape[0] == 2
+        per_iter = []
+        for _ in range(2):
+            b.update()
+            per_iter.append(np.asarray(b.gbdt.valid_scores[0]).copy())
+        np.testing.assert_array_equal(
+            np.asarray(a.gbdt.valid_scores[0]), per_iter[-1])
+        for j in range(2):
+            np.testing.assert_array_equal(
+                np.asarray(traj[0][j]), per_iter[j], err_msg=f"iter {j}")
