@@ -211,11 +211,59 @@ class _Bench:
         return None, False
 
 
+def _serve_bench(bench, result):
+    """Serve-path record: a mixed-size request stream (1..1000 rows)
+    through serving.Server on the just-trained booster. Keys MERGE into
+    the single JSON record — never a second JSON line (the round
+    tooling parses exactly one). Best-effort: a serving fault leaves
+    the zeroed schema keys in place, it cannot retract the training
+    record."""
+    n_req = int(os.environ.get("BENCH_SERVE_REQUESTS", 48))
+    if n_req <= 0 or bench is None or bench.booster is None or bench.dead:
+        return
+    try:
+        from lightgbm_tpu.serving import Server
+        rng = np.random.RandomState(5)
+        Xq, _ = make_higgs_like(4096, N_FEATURES, seed=23)
+        sizes = [int(rng.choice([1, 4, 16, 64, 256, 1000]))
+                 for _ in range(n_req)]
+        with Server(min_bucket=16, max_bucket=1024,
+                    max_wait_ms=0.5) as srv:
+            srv.load_model("bench", booster=bench.booster)
+            for s in sizes:
+                lo = int(rng.randint(0, 4096 - s)) if s < 4096 else 0
+                srv.predict("bench", Xq[lo:lo + s])
+            snap = srv.metrics_snapshot("bench")["models"]["bench"]
+        for src, dst in (("qps", "serve_qps"),
+                         ("rows_per_sec", "serve_rows_per_sec"),
+                         ("p50_ms", "serve_p50_ms"),
+                         ("p95_ms", "serve_p95_ms"),
+                         ("p99_ms", "serve_p99_ms"),
+                         ("buckets_compiled", "serve_buckets_compiled"),
+                         ("bucket_cache_hits", "serve_bucket_hits")):
+            result[dst] = snap[src]
+        print(f"# serve detail: {snap['requests']} requests "
+              f"({snap['rows']} rows), {snap['buckets_compiled']} "
+              f"buckets compiled (bound {snap['max_compilations']}), "
+              f"p50/p95/p99 {snap['p50_ms']}/{snap['p95_ms']}/"
+              f"{snap['p99_ms']} ms, {snap['qps']} req/s",
+              file=sys.stderr)
+    except Exception as exc:
+        print(f"# serve bench failed: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
+
+
 def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     result = {"metric": "higgs1m_trees_per_sec", "value": 0.0,
               "unit": "trees/sec", "vs_baseline": 0.0,
-              "vs_single_core": 0.0}
+              "vs_single_core": 0.0,
+              # serve-path schema (filled by _serve_bench; zeros when
+              # the serve bench is skipped or faults)
+              "serve_qps": 0.0, "serve_rows_per_sec": 0.0,
+              "serve_p50_ms": 0.0, "serve_p95_ms": 0.0,
+              "serve_p99_ms": 0.0, "serve_buckets_compiled": 0,
+              "serve_bucket_hits": 0}
     block_times = []
     block_trees = min(BLOCK_TREES, BENCH_TREES)
     bench = None
@@ -264,6 +312,7 @@ def main():
             median_rate / BASELINE_TREES_PER_SEC, 3)
         result["vs_single_core"] = round(
             median_rate / SINGLE_CORE_TREES_PER_SEC, 3)
+    _serve_bench(bench, result)
     return result, block_times, block_trees, bench
 
 

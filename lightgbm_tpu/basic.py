@@ -700,6 +700,22 @@ class Booster:
             self._model = model
         return self._model
 
+    def device_forest(self):
+        """Memoized device-stacked serving forest (serving/forest.py).
+
+        Repeated serving calls reuse the resident arrays instead of
+        re-stacking the trees per call. Invalidation is by HostModel
+        identity: every mutation point (update / update_batch /
+        rollback_one_iter / model reload) clears `self._model`, so the
+        next call here sees a fresh HostModel object and rebuilds."""
+        model = self._host_model()
+        cached = getattr(self, "_device_forest", None)
+        if cached is not None and cached._model is model:
+            return cached
+        from .serving.forest import build_device_forest
+        self._device_forest = build_device_forest(model)
+        return self._device_forest
+
     def predict(self, data, start_iteration: int = 0,
                 num_iteration: Optional[int] = None, raw_score: bool = False,
                 pred_leaf: bool = False, pred_contrib: bool = False,

@@ -39,7 +39,7 @@ _PARAMS: List[ParamSpec] = [
     _p("config", str, "", ("config_file",)),
     _p("task", str, "train",
        ("task_type",), lambda v: v in ("train", "predict", "convert_model",
-                                       "refit", "save_binary")),
+                                       "refit", "save_binary", "serve")),
     _p("objective", str, "regression",
        ("objective_type", "app", "application", "loss")),
     _p("boosting", str, "gbdt",
@@ -185,6 +185,16 @@ _PARAMS: List[ParamSpec] = [
     _p("output_result", str, "LightGBM_predict_result.txt",
        ("predict_result", "prediction_result", "predict_name",
         "prediction_name", "pred_name", "name_pred")),
+    # ---- Serving (lightgbm_tpu/serving/, task=serve) ----
+    _p("serve_max_batch_size", int, 1024, ("max_batch_size",),
+       lambda v: v > 0),
+    _p("serve_max_wait_ms", float, 2.0,
+       ("max_wait_ms", "batch_timeout_ms"), lambda v: v >= 0),
+    _p("serve_max_queue", int, 128, ("max_queue_depth",), lambda v: v > 0),
+    _p("serve_min_bucket", int, 16, ("min_bucket",), lambda v: v > 0),
+    _p("serve_max_bucket", int, 1024, ("max_bucket",), lambda v: v > 0),
+    _p("serve_max_models", int, 8, (), lambda v: v > 0),
+    _p("serve_metrics_file", str, "", ("metrics_file",)),
     # ---- Convert (config.h:1006-1020) ----
     _p("convert_model_language", str, ""),
     _p("convert_model", str, "gbdt_prediction.cpp",
@@ -403,6 +413,12 @@ class Config:
             full = 1 << min(self.max_depth, 30)
             if self.num_leaves > full:
                 self.num_leaves = full
+        if self.serve_max_bucket < self.serve_min_bucket:
+            from .utils.log import Log
+            Log.warning(
+                "serve_max_bucket < serve_min_bucket; raising "
+                "serve_max_bucket to %d", self.serve_min_bucket)
+            self.serve_max_bucket = self.serve_min_bucket
         if self.num_machines > 1 and self.tree_learner == "serial":
             # reference config.cpp:293-299: serial learner forces
             # single-machine (theirs is silent; warn so nobody believes

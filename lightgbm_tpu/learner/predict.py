@@ -64,10 +64,20 @@ def _traverse(tree: TreeArrays, bins: jax.Array, num_bins: jax.Array,
 def predict_binned_tree(tree: TreeArrays, bins: jax.Array,
                         num_bins: jax.Array,
                         missing_is_nan: jax.Array,
-                        efb=None) -> jax.Array:
-    """[N] leaf values of one tree."""
+                        efb=None, row_valid=None) -> jax.Array:
+    """[N] leaf values of one tree.
+
+    `row_valid` ([N] bool, optional) marks pad rows inert: their output is
+    exactly 0.0. Real rows are untouched — every traversal op is
+    elementwise per row (the while_loop predicate only controls trip
+    count, and settled rows are fixed points of the body), so a
+    bucket-padded batch returns bit-identical values on its real rows.
+    """
     leaf = _traverse(tree, bins, num_bins, missing_is_nan, efb)
-    return tree.leaf_value[leaf]
+    vals = tree.leaf_value[leaf]
+    if row_valid is not None:
+        vals = jnp.where(row_valid, vals, jnp.float32(0.0))
+    return vals
 
 
 @jax.jit
@@ -94,11 +104,17 @@ def leaf_index_tree(tree: TreeArrays, bins: jax.Array, num_bins: jax.Array,
 def predict_binned_forest(stacked: TreeArrays, tree_class: jax.Array,
                           bins: jax.Array, num_bins: jax.Array,
                           missing_is_nan: jax.Array,
-                          num_outputs: int = 1) -> jax.Array:
+                          num_outputs: int = 1,
+                          row_valid=None) -> jax.Array:
     """Sum leaf values over a stacked forest.
 
     stacked: TreeArrays whose fields have a leading tree axis [T, ...].
     tree_class: [T] output column each tree adds to (multiclass).
+    row_valid: [N] bool, optional. Pad rows (False) accumulate exactly
+    0.0 in every output column while real rows stay bit-identical to an
+    unpadded batch (per-row elementwise traversal; see
+    predict_binned_tree). This is what lets the serving engine pad
+    batches up to shape buckets without perturbing scores.
     Returns [N, num_outputs] raw scores.
     """
     n = bins.shape[0]
@@ -106,7 +122,8 @@ def predict_binned_forest(stacked: TreeArrays, tree_class: jax.Array,
 
     def body(i, acc):
         tree = jax.tree_util.tree_map(lambda a: a[i], stacked)
-        vals = predict_binned_tree(tree, bins, num_bins, missing_is_nan)
+        vals = predict_binned_tree(tree, bins, num_bins, missing_is_nan,
+                                   row_valid=row_valid)
         return acc.at[:, tree_class[i]].add(vals)
 
     out = jnp.zeros((n, num_outputs), jnp.float32)

@@ -1,0 +1,21 @@
+"""TPU-resident inference serving engine.
+
+Loads a trained (or file-loaded) Booster once into stacked device
+arrays and serves request streams through a shape-bucketed compiled
+predictor with micro-batching, admission control, host fallback, and a
+per-model metrics surface. See docs/Serving.md and `Server`.
+"""
+
+from .batcher import MicroBatcher, OverloadError
+from .engine import BucketedPredictor, max_compilations, next_bucket
+from .forest import DeviceForest, FeatureBinner, build_device_forest
+from .metrics import ModelMetrics
+from .registry import ModelEntry, ModelRegistry
+from .server import Server
+
+__all__ = [
+    "Server", "ModelRegistry", "ModelEntry", "ModelMetrics",
+    "MicroBatcher", "OverloadError", "BucketedPredictor",
+    "DeviceForest", "FeatureBinner", "build_device_forest",
+    "next_bucket", "max_compilations",
+]

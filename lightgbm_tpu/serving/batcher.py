@@ -1,0 +1,172 @@
+"""Micro-batching request queue with admission control.
+
+Concurrent small predict requests are coalesced into one device call:
+a background worker drains the queue, packing requests in FIFO order
+until `max_batch_size` rows are gathered or `max_wait_ms` has elapsed
+since the oldest queued request. One device batch then serves them all
+and each caller's Future gets its slice back — per-request launch
+overhead amortizes across the coalesced batch (the same motivation as
+the reference's row-parallel Predictor, but across *requests* instead
+of rows).
+
+Admission control: once `max_queue` requests are waiting, new arrivals
+are shed immediately with `OverloadError` instead of growing the queue
+without bound — a bounded queue keeps tail latency bounded too.
+
+`pause()`/`resume()` freeze the worker between batches; tests use this
+to enqueue a deterministic set of requests and observe exactly one
+coalesced device batch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..utils.log import Log
+
+__all__ = ["MicroBatcher", "OverloadError"]
+
+
+class OverloadError(RuntimeError):
+    """Request shed by admission control (queue depth exceeded)."""
+
+
+class _Request:
+    __slots__ = ("bins", "future", "t_enqueue")
+
+    def __init__(self, bins: np.ndarray):
+        self.bins = bins
+        self.future: Future = Future()
+        self.t_enqueue = time.monotonic()
+
+
+class MicroBatcher:
+    """FIFO coalescing queue in front of one model's device predictor.
+
+    `run_batch([N, F] bins) -> [N, num_outputs]` is the only downstream
+    dependency; the batcher never imports JAX itself.
+    """
+
+    def __init__(self, run_batch: Callable[[np.ndarray], np.ndarray],
+                 max_batch_size: int = 1024, max_wait_ms: float = 2.0,
+                 max_queue: int = 128, name: str = "model"):
+        if max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        self._run_batch = run_batch
+        self.max_batch_size = int(max_batch_size)
+        self.max_wait_ms = float(max_wait_ms)
+        self.max_queue = int(max_queue)
+        self.name = name
+        self._queue: List[_Request] = []
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._paused = False
+        self._closed = False
+        self.shed_count = 0
+        self.batch_count = 0
+        self.coalesced_requests = 0
+        self._worker = threading.Thread(
+            target=self._loop, name=f"serve-batcher-{name}", daemon=True)
+        self._worker.start()
+
+    # ------------------------------------------------------------------
+    def submit(self, bins: np.ndarray) -> Future:
+        """Queue one request's binned rows; resolves to its raw scores."""
+        req = _Request(bins)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            if len(self._queue) >= self.max_queue:
+                self.shed_count += 1
+                raise OverloadError(
+                    f"serving queue for '{self.name}' is full "
+                    f"({self.max_queue} requests waiting)")
+            self._queue.append(req)
+            self._wake.notify()
+        return req.future
+
+    def pause(self) -> None:
+        """Freeze the worker between batches (deterministic tests)."""
+        with self._lock:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._lock:
+            self._paused = False
+            self._wake.notify()
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def close(self, timeout: float = 5.0) -> None:
+        with self._lock:
+            self._closed = True
+            self._paused = False
+            self._wake.notify()
+        self._worker.join(timeout=timeout)
+        # fail any stragglers instead of hanging their callers
+        with self._lock:
+            leftovers, self._queue = self._queue, []
+        for req in leftovers:
+            if not req.future.done():
+                req.future.set_exception(RuntimeError("batcher closed"))
+
+    # ------------------------------------------------------------------
+    def _take_batch(self) -> Optional[List[_Request]]:
+        """Block until a coalescible batch is ready (or closed)."""
+        with self._lock:
+            while True:
+                if self._closed and not self._queue:
+                    return None
+                if self._queue and not self._paused:
+                    oldest = self._queue[0].t_enqueue
+                    rows = 0
+                    take = 0
+                    for req in self._queue:
+                        if take and rows + len(req.bins) > \
+                                self.max_batch_size:
+                            break
+                        rows += len(req.bins)
+                        take += 1
+                        if rows >= self.max_batch_size:
+                            break
+                    waited_ms = (time.monotonic() - oldest) * 1e3
+                    if (rows >= self.max_batch_size or self._closed or
+                            waited_ms >= self.max_wait_ms):
+                        batch = self._queue[:take]
+                        del self._queue[:take]
+                        return batch
+                    # more coalescing headroom: sleep out the window
+                    self._wake.wait(
+                        timeout=(self.max_wait_ms - waited_ms) / 1e3)
+                    continue
+                self._wake.wait(timeout=0.1)
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            self.batch_count += 1
+            self.coalesced_requests += len(batch)
+            try:
+                bins = batch[0].bins if len(batch) == 1 else \
+                    np.concatenate([r.bins for r in batch], axis=0)
+                raw = self._run_batch(bins)
+                lo = 0
+                for req in batch:
+                    hi = lo + len(req.bins)
+                    req.future.set_result(raw[lo:hi])
+                    lo = hi
+            except Exception as exc:  # surface to callers, keep serving
+                Log.warning(f"serving batch for '{self.name}' failed: "
+                            f"{exc}")
+                for req in batch:
+                    if not req.future.done():
+                        req.future.set_exception(exc)

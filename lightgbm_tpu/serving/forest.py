@@ -1,0 +1,335 @@
+"""Device-resident serving forest: one-time Booster -> device-array load.
+
+The training-side device predictor (learner/predict.py) works in BIN
+space: rows are quantized with the training BinMappers and every node
+decision is an exact integer compare. At serving time the training
+mappers may be gone (model loaded from a text file), so the forest is
+rebuilt from the model itself: the only feature values a tree ever
+compares against are its own split thresholds, so binning new rows
+against the sorted set of per-feature thresholds reproduces every
+`value <= threshold` decision exactly (the same trick the reference's
+CUDA predictor uses to avoid re-binning, and what makes the serving
+path self-contained — no Dataset required).
+
+Per-feature missing handling is folded into the reconstruction:
+
+- missing_type NAN  -> a trailing NaN bin routed by each node's
+  default_left (the `missing_is_nan` mechanism of `_traverse`).
+- missing_type ZERO -> NaN maps to 0.0 first, then |v| <= kZeroThreshold
+  maps to the trailing default-routed bin — exactly the reference's
+  NumericalDecision ZERO branch (tree.h:335-412) expressed in bin space.
+- missing_type NONE -> NaN maps to 0.0 and bins normally.
+
+Categorical features bin raw category values through a rank LUT; bin 0
+is the unseen/NaN dummy whose bit is never set in any node bitset, so
+unseen categories fall right — matching HostTree.predict_rows.
+
+A model whose numeric nodes disagree on missing_type within one feature
+(impossible for models trained here, possible for foreign hand-edited
+files) or that uses linear leaves is marked unsupported; the serving
+engine then degrades to the host predict path instead of guessing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..tree import HostModel, HostTree
+
+__all__ = ["DeviceForest", "FeatureBinner", "build_device_forest"]
+
+_CAT_BIT = 1
+_DEFAULT_LEFT_BIT = 2
+_MISSING_SHIFT = 2
+_ZERO_THRESHOLD = 1e-35
+
+
+@dataclasses.dataclass
+class FeatureBinner:
+    """Host-side quantizer for one original feature, rebuilt from the
+    model's own split thresholds (numeric) or category bitsets (cat)."""
+    is_categorical: bool = False
+    # numeric: sorted unique split thresholds; v <= edges[k] <-> bin <= k
+    edges: Optional[np.ndarray] = None
+    missing_type: int = 0          # 0 None, 1 Zero, 2 NaN (tree.h masks)
+    # categorical: raw category value -> bin (0 = unseen/NaN dummy)
+    cat_to_bin: Optional[Dict[int, int]] = None
+    num_bin: int = 1
+
+    @property
+    def has_default_bin(self) -> bool:
+        """Trailing bin routed by default_left (NaN bin / zero bin)."""
+        return not self.is_categorical and self.missing_type in (1, 2)
+
+    def bin_values(self, col: np.ndarray) -> np.ndarray:
+        """[N] raw float column -> [N] int32 serving bins."""
+        if self.is_categorical:
+            out = np.zeros(len(col), np.int32)
+            ok = np.isfinite(col) & (col >= 0) & (col < 2147483647.0)
+            lut = self.cat_to_bin or {}
+            ints = col[ok].astype(np.int64)
+            out[ok] = np.array([lut.get(int(v), 0) for v in ints],
+                               np.int32) if len(ints) else 0
+            return out
+        if self.edges is None or len(self.edges) == 0:
+            return np.zeros(len(col), np.int32)
+        isnan = np.isnan(col)
+        vals = np.where(isnan, 0.0, col)  # NONE/ZERO: NaN behaves as 0
+        out = np.searchsorted(self.edges, vals, side="left").astype(np.int32)
+        if self.missing_type == 2:          # NAN: dedicated trailing bin
+            out = np.where(isnan, self.num_bin - 1, out)
+        elif self.missing_type == 1:        # ZERO: |v|<=eps default-routed
+            out = np.where(np.abs(vals) <= _ZERO_THRESHOLD,
+                           self.num_bin - 1, out)
+        return out.astype(np.int32)
+
+
+class _StackedArrays:
+    """Forest-shaped numpy staging buffers before the device push."""
+
+    def __init__(self, t: int, m1: int, w: int):
+        self.split_feature = np.full((t, m1), -1, np.int32)
+        self.threshold_bin = np.zeros((t, m1), np.int32)
+        self.default_left = np.zeros((t, m1), bool)
+        self.is_cat = np.zeros((t, m1), bool)
+        self.cat_bitset = np.zeros((t, m1, w), np.uint32)
+        self.left = np.full((t, m1), -1, np.int32)
+        self.right = np.full((t, m1), -1, np.int32)
+        self.parent = np.full((t, m1), -1, np.int32)
+        self.leaf_value = np.zeros((t, m1), np.float32)
+        self.num_nodes = np.zeros(t, np.int32)
+        self.num_leaves = np.zeros(t, np.int32)
+
+
+@dataclasses.dataclass
+class DeviceForest:
+    """Stacked device arrays + host binners for one loaded model.
+
+    Built once per model load (see `build_device_forest` /
+    `Booster.device_forest`), then shared by every request: the serving
+    hot path only bins rows and calls the jitted
+    `predict_binned_forest` on the resident arrays.
+    """
+    stacked: object                 # TreeArrays with leading [T] axis
+    tree_class: object              # jnp [T] i32
+    num_bins: object                # jnp [F] i32
+    missing_is_nan: object          # jnp [F] bool
+    binners: List[FeatureBinner]
+    num_outputs: int
+    num_features: int
+    num_trees: int
+    objective: str
+    average_output: bool
+    num_iterations: int
+    supported: bool = True
+    unsupported_reason: str = ""
+    _model: Optional[HostModel] = None
+
+    def bin_rows(self, X: np.ndarray) -> np.ndarray:
+        """[N, >=F] raw features -> [N, F] int32 serving bins."""
+        X = np.asarray(X, np.float64)
+        n = X.shape[0]
+        out = np.zeros((n, self.num_features), np.int32)
+        for f, binner in enumerate(self.binners):
+            if f >= X.shape[1]:
+                break
+            out[:, f] = binner.bin_values(X[:, f])
+        return out
+
+    def convert_raw(self, raw: np.ndarray,
+                    raw_score: bool = False) -> np.ndarray:
+        """Raw device scores -> HostModel.predict output: averaged for
+        RF models, objective-converted unless raw_score, [N] when the
+        model has a single output column."""
+        raw = np.asarray(raw, np.float64)
+        if self.average_output:
+            raw = raw / max(self.num_iterations, 1)
+        if not raw_score and self._model is not None:
+            raw = self._model._convert_output(raw)
+        return raw[:, 0] if self.num_outputs == 1 else raw
+
+    def nbytes_device(self) -> int:
+        import jax
+        return sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                   for a in jax.tree_util.tree_leaves(self.stacked))
+
+
+def _node_missing_type(dt: int) -> int:
+    return (dt >> _MISSING_SHIFT) & 3
+
+
+def _collect_binners(model: HostModel) -> (List[FeatureBinner], str):
+    """Rebuild per-feature quantizers from the forest's own decisions."""
+    nf = model.max_feature_idx + 1
+    thresholds: List[set] = [set() for _ in range(nf)]
+    cat_vals: List[set] = [set() for _ in range(nf)]
+    mtypes: List[set] = [set() for _ in range(nf)]
+    is_cat_f = np.zeros(nf, bool)
+    for t in model.trees:
+        for i in range(t.num_leaves - 1):
+            f = int(t.split_feature[i])
+            dt = int(t.decision_type[i])
+            if dt & _CAT_BIT:
+                is_cat_f[f] = True
+                ci = int(t.threshold[i])
+                lo = int(t.cat_boundaries[ci])
+                hi = int(t.cat_boundaries[ci + 1])
+                for w in range(lo, hi):
+                    word = int(t.cat_threshold[w])
+                    base = (w - lo) * 32
+                    while word:
+                        b = (word & -word).bit_length() - 1
+                        cat_vals[f].add(base + b)
+                        word &= word - 1
+            else:
+                thresholds[f].add(float(t.threshold[i]))
+                mtypes[f].add(_node_missing_type(dt))
+    binners: List[FeatureBinner] = []
+    for f in range(nf):
+        if is_cat_f[f] and thresholds[f]:
+            return [], (f"feature {f} mixes categorical and numerical "
+                        "splits")
+        if len(mtypes[f]) > 1:
+            return [], (f"feature {f} mixes missing_type values "
+                        f"{sorted(mtypes[f])} across nodes")
+        if is_cat_f[f]:
+            cats = sorted(cat_vals[f])
+            binners.append(FeatureBinner(
+                is_categorical=True,
+                cat_to_bin={c: i + 1 for i, c in enumerate(cats)},
+                num_bin=len(cats) + 1))
+        else:
+            edges = np.asarray(sorted(thresholds[f]), np.float64)
+            mt = next(iter(mtypes[f])) if mtypes[f] else 0
+            # bins: len(edges)+1 value ranges, +1 default-routed bin for
+            # NAN/ZERO missing handling
+            nb = len(edges) + 1 + (1 if mt in (1, 2) else 0)
+            binners.append(FeatureBinner(edges=edges, missing_type=mt,
+                                         num_bin=nb))
+    return binners, ""
+
+
+def _fill_tree(buf: _StackedArrays, ti: int, t: HostTree,
+               binners: List[FeatureBinner]) -> None:
+    """One HostTree (reference numbering: internal 0..ni-1, leaf ~li)
+    into node-id space (internal i -> i, leaf li -> ni + li)."""
+    ni = max(t.num_leaves - 1, 0)
+    nl = t.num_leaves
+
+    def node_id(c: int) -> int:
+        return c if c >= 0 else ni + (~c)
+
+    for i in range(ni):
+        f = int(t.split_feature[i])
+        dt = int(t.decision_type[i])
+        binner = binners[f]
+        buf.split_feature[ti, i] = f
+        buf.left[ti, i] = node_id(int(t.left_child[i]))
+        buf.right[ti, i] = node_id(int(t.right_child[i]))
+        if dt & _CAT_BIT:
+            buf.is_cat[ti, i] = True
+            ci = int(t.threshold[i])
+            lo = int(t.cat_boundaries[ci])
+            hi = int(t.cat_boundaries[ci + 1])
+            lut = binner.cat_to_bin or {}
+            for w in range(lo, hi):
+                word = int(t.cat_threshold[w])
+                base = (w - lo) * 32
+                while word:
+                    b = (word & -word).bit_length() - 1
+                    sb = lut.get(base + b, 0)
+                    if sb > 0:
+                        buf.cat_bitset[ti, i, sb // 32] |= np.uint32(
+                            1 << (sb % 32))
+                    word &= word - 1
+        else:
+            thr = float(t.threshold[i])
+            # exact: thr is a member of the edge set by construction
+            buf.threshold_bin[ti, i] = int(
+                np.searchsorted(binner.edges, thr, side="left"))
+            mt = _node_missing_type(dt)
+            if mt in (1, 2):
+                buf.default_left[ti, i] = bool(dt & _DEFAULT_LEFT_BIT)
+        children = (int(t.left_child[i]), int(t.right_child[i]))
+        for c in children:
+            buf.parent[ti, node_id(c)] = i
+    for li in range(nl):
+        buf.leaf_value[ti, ni + li] = np.float32(t.leaf_value[li])
+    buf.num_nodes[ti] = ni + nl
+    buf.num_leaves[ti] = nl
+
+
+def build_device_forest(model: HostModel) -> DeviceForest:
+    """Flatten + stack a HostModel into resident device arrays.
+
+    Returns an unsupported (host-fallback) DeviceForest instead of
+    raising when the model cannot be served from device exactly.
+    """
+    import jax.numpy as jnp
+    from ..learner.grower import TreeArrays
+
+    k = max(model.num_tree_per_iteration, 1)
+    nf = model.max_feature_idx + 1
+
+    def unsupported(reason: str) -> DeviceForest:
+        return DeviceForest(
+            stacked=None, tree_class=None, num_bins=None,
+            missing_is_nan=None, binners=[], num_outputs=k,
+            num_features=nf, num_trees=len(model.trees),
+            objective=model.objective,
+            average_output=model.average_output,
+            num_iterations=model.num_iterations,
+            supported=False, unsupported_reason=reason, _model=model)
+
+    if not model.trees:
+        return unsupported("model has no trees")
+    if any(t.is_linear for t in model.trees):
+        return unsupported("linear-leaf models need raw feature values; "
+                           "served via the host predict path")
+    binners, why = _collect_binners(model)
+    if why:
+        return unsupported(why)
+
+    m1 = max(max(t.num_leaves - 1, 0) + t.num_leaves
+             for t in model.trees) + 1          # + scratch row
+    max_cat_bin = max((b.num_bin for b in binners if b.is_categorical),
+                      default=1)
+    w = max((max_cat_bin - 1) // 32 + 1, 1)
+    buf = _StackedArrays(len(model.trees), m1, w)
+    for ti, t in enumerate(model.trees):
+        _fill_tree(buf, ti, t, binners)
+
+    stacked = TreeArrays(
+        split_feature=jnp.asarray(buf.split_feature),
+        threshold_bin=jnp.asarray(buf.threshold_bin),
+        default_left=jnp.asarray(buf.default_left),
+        is_cat=jnp.asarray(buf.is_cat),
+        cat_bitset=jnp.asarray(buf.cat_bitset),
+        left=jnp.asarray(buf.left),
+        right=jnp.asarray(buf.right),
+        parent=jnp.asarray(buf.parent),
+        leaf_value=jnp.asarray(buf.leaf_value),
+        sum_grad=jnp.zeros((len(model.trees), m1), jnp.float32),
+        sum_hess=jnp.zeros((len(model.trees), m1), jnp.float32),
+        count=jnp.zeros((len(model.trees), m1), jnp.float32),
+        gain=jnp.zeros((len(model.trees), m1), jnp.float32),
+        depth=jnp.zeros((len(model.trees), m1), jnp.int32),
+        is_leaf=jnp.asarray(buf.split_feature < 0),
+        num_nodes=jnp.asarray(buf.num_nodes),
+        num_leaves=jnp.asarray(buf.num_leaves))
+    tree_class = jnp.asarray(
+        [model.tree_class[i] if i < len(model.tree_class) else i % k
+         for i in range(len(model.trees))], jnp.int32)
+    num_bins = jnp.asarray([b.num_bin for b in binners], jnp.int32)
+    # the trailing default-routed bin (NaN bin or ZERO bin) rides the
+    # traversal's missing_is_nan mechanism either way
+    missing = jnp.asarray([b.has_default_bin for b in binners])
+    return DeviceForest(
+        stacked=stacked, tree_class=tree_class, num_bins=num_bins,
+        missing_is_nan=missing, binners=binners, num_outputs=k,
+        num_features=nf, num_trees=len(model.trees),
+        objective=model.objective, average_output=model.average_output,
+        num_iterations=model.num_iterations, _model=model)

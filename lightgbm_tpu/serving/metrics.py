@@ -1,0 +1,109 @@
+"""Per-model serving metrics: QPS, latency percentiles, cache, sheds.
+
+A lock-guarded ring buffer of request latencies plus monotonic
+counters; `snapshot()` renders a JSON-able dict (the schema documented
+in docs/Serving.md). Device/binning phase totals ride the process-wide
+`utils.timer.global_timer` under ``serve_*`` keys, so `python -c`
+profiling and the training phases share one report.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..utils.timer import global_timer
+
+__all__ = ["ModelMetrics"]
+
+_PERCENTILES = (50.0, 95.0, 99.0)
+
+
+class ModelMetrics:
+    """Counters + bounded latency reservoir for one registered model."""
+
+    def __init__(self, window: int = 2048):
+        self._lock = threading.Lock()
+        self._window = max(int(window), 16)
+        self._lat_ms = np.zeros(self._window, np.float64)
+        self._lat_n = 0          # total recorded (ring writes)
+        self.requests = 0
+        self.rows = 0
+        self.batches = 0         # coalesced device batches
+        self.bucket_hits = 0
+        self.compile_count = 0
+        self.shed_count = 0
+        self.fallback_count = 0  # requests served by the host path
+        self.errors = 0
+        self._started = time.monotonic()
+        self._first_request: Optional[float] = None
+        self._last_request: Optional[float] = None
+
+    # ------------------------------------------------------------------
+    def record_request(self, rows: int, latency_s: float,
+                       fallback: bool = False) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self.requests += 1
+            self.rows += int(rows)
+            if fallback:
+                self.fallback_count += 1
+            self._lat_ms[self._lat_n % self._window] = latency_s * 1e3
+            self._lat_n += 1
+            if self._first_request is None:
+                self._first_request = now
+            self._last_request = now
+
+    def record_batch(self, bucket_hit: bool, compiled: bool) -> None:
+        with self._lock:
+            self.batches += 1
+            if bucket_hit:
+                self.bucket_hits += 1
+            if compiled:
+                self.compile_count += 1
+
+    def record_shed(self) -> None:
+        with self._lock:
+            self.shed_count += 1
+
+    def record_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    # ------------------------------------------------------------------
+    def snapshot(self) -> Dict:
+        with self._lock:
+            n = min(self._lat_n, self._window)
+            lats = np.sort(self._lat_ms[:n]) if n else np.zeros(0)
+            span = None
+            if self._first_request is not None and self.requests > 1:
+                span = max(self._last_request - self._first_request, 1e-9)
+            qps = (self.requests / span) if span else float(self.requests)
+            rows_per_s = (self.rows / span) if span else float(self.rows)
+            out = {
+                "requests": self.requests,
+                "rows": self.rows,
+                "batches": self.batches,
+                "qps": round(qps, 3),
+                "rows_per_sec": round(rows_per_s, 3),
+                "bucket_cache_hits": self.bucket_hits,
+                "compile_count": self.compile_count,
+                "shed_count": self.shed_count,
+                "fallback_count": self.fallback_count,
+                "errors": self.errors,
+                "uptime_sec": round(time.monotonic() - self._started, 3),
+            }
+            for p in _PERCENTILES:
+                key = f"p{int(p)}_ms"
+                out[key] = round(float(np.percentile(lats, p)), 3) \
+                    if n else None
+        return out
+
+
+def timer_totals() -> Dict[str, float]:
+    """serve_* phase totals from the process-global timer."""
+    return {k: round(v, 6) for k, v in global_timer.totals().items()
+            if k.startswith("serve_")}

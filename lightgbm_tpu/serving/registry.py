@@ -1,0 +1,141 @@
+"""Model registry: load a Booster once, serve it many times.
+
+Each entry pins one model's `DeviceForest` (stacked TreeArrays + host
+binners) in device memory so the request hot path never re-stacks tree
+arrays or re-parses a model file. Lifecycle is explicit:
+
+- `load(name, ...)`   Booster / model file / model string -> resident
+- `refresh(name, ...)` atomically swap in a new version (in-flight
+  requests finish against the old arrays — JAX arrays are immutable,
+  so the swap is just a reference move)
+- `evict(name)`       drop the entry; device memory frees with the
+  last array reference
+
+Capacity is bounded: loading past `max_models` evicts the least
+recently *used* entry (use = a `get`), mirroring the bucket cache's
+"bounded resources, predictable behavior" contract.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..utils.log import Log, LightGBMError
+from .forest import DeviceForest, build_device_forest
+from .metrics import ModelMetrics
+
+__all__ = ["ModelRegistry", "ModelEntry"]
+
+
+@dataclass
+class ModelEntry:
+    name: str
+    forest: DeviceForest
+    booster: object                     # the source Booster (host fallback)
+    metrics: ModelMetrics
+    loaded_at: float
+    version: int = 1
+    last_used: float = field(default=0.0)
+    # set by the server after a device failure: subsequent requests for
+    # this entry take the host path until the model is refreshed
+    degraded: bool = False
+
+
+def _forest_from_source(booster=None, model_file: Optional[str] = None,
+                        model_str: Optional[str] = None):
+    from ..basic import Booster
+    if booster is None:
+        if model_file is None and model_str is None:
+            raise LightGBMError(
+                "registry.load needs a booster, model_file or model_str")
+        booster = Booster(model_file=model_file, model_str=model_str)
+    forest = booster.device_forest()
+    return booster, forest
+
+
+class ModelRegistry:
+    """Thread-safe name -> ModelEntry map with LRU capacity."""
+
+    def __init__(self, max_models: int = 8):
+        if max_models < 1:
+            raise ValueError("max_models must be >= 1")
+        self.max_models = int(max_models)
+        self._entries: Dict[str, ModelEntry] = {}
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------------
+    def load(self, name: str, booster=None,
+             model_file: Optional[str] = None,
+             model_str: Optional[str] = None) -> ModelEntry:
+        """Build + pin the device forest for `name`. Idempotent per
+        name: loading an existing name is a refresh."""
+        booster, forest = _forest_from_source(booster, model_file,
+                                              model_str)
+        with self._lock:
+            prev = self._entries.get(name)
+            entry = ModelEntry(
+                name=name, forest=forest, booster=booster,
+                metrics=prev.metrics if prev else ModelMetrics(),
+                loaded_at=time.monotonic(),
+                version=(prev.version + 1) if prev else 1,
+                last_used=time.monotonic())
+            self._entries[name] = entry
+            self._evict_over_capacity()
+        if not forest.supported:
+            Log.warning(
+                f"serving model '{name}' on the host fallback path: "
+                f"{forest.unsupported_reason}")
+        Log.info(f"serving: loaded model '{name}' v{entry.version} "
+                 f"({forest.num_trees} trees, "
+                 f"{forest.num_features} features)")
+        return entry
+
+    def refresh(self, name: str, booster=None,
+                model_file: Optional[str] = None,
+                model_str: Optional[str] = None) -> ModelEntry:
+        """Atomic swap to a new model version under the same name."""
+        with self._lock:
+            if name not in self._entries:
+                raise LightGBMError(f"model '{name}' is not loaded")
+        return self.load(name, booster=booster, model_file=model_file,
+                         model_str=model_str)
+
+    def get(self, name: str) -> ModelEntry:
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None:
+                raise LightGBMError(f"model '{name}' is not loaded")
+            entry.last_used = time.monotonic()
+            return entry
+
+    def evict(self, name: str) -> bool:
+        """Drop `name`; returns False when it was not loaded."""
+        with self._lock:
+            entry = self._entries.pop(name, None)
+        if entry is not None:
+            Log.info(f"serving: evicted model '{name}'")
+        return entry is not None
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def __contains__(self, name: str) -> bool:
+        with self._lock:
+            return name in self._entries
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    # ------------------------------------------------------------------
+    def _evict_over_capacity(self) -> None:
+        # caller holds the lock
+        while len(self._entries) > self.max_models:
+            lru = min(self._entries.values(), key=lambda e: e.last_used)
+            del self._entries[lru.name]
+            Log.warning(f"serving: capacity {self.max_models} reached, "
+                        f"evicted LRU model '{lru.name}'")

@@ -1,0 +1,237 @@
+"""The serving facade: registry + bucket cache + micro-batcher + metrics.
+
+    server = Server(max_batch_size=512, max_wait_ms=2.0)
+    server.load_model("clf", booster=bst)          # one-time device load
+    probs = server.predict("clf", X)               # == bst.predict(X)
+    print(json.dumps(server.metrics_snapshot()))
+
+Request path: `predict` bins the rows on the host (cheap integer
+quantization), submits them to the model's `MicroBatcher`, and blocks
+on the Future; the batcher worker coalesces concurrent requests into
+one device dispatch through the shared `BucketedPredictor`. Responses
+are converted to output space host-side, so results match
+`Booster.predict` (device accumulation is f32; see tests for the
+tolerance contract, and the padded-row test for the bit-identity of
+bucket padding itself).
+
+Degradation ladder: unsupported model -> host path from the start;
+device dispatch raises -> that request is served by the host path, the
+entry is marked degraded, and later requests skip the device until a
+`refresh_model`. Overload -> `OverloadError` before any work is done.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..utils.log import Log, LightGBMError
+from ..utils.timer import global_timer
+from .batcher import MicroBatcher, OverloadError
+from .engine import BucketedPredictor, max_compilations
+from .metrics import timer_totals
+from .registry import ModelEntry, ModelRegistry
+
+__all__ = ["Server", "OverloadError"]
+
+
+class Server:
+    """TPU-resident inference server for LightGBM boosters."""
+
+    def __init__(self, *, max_batch_size: int = 1024,
+                 max_wait_ms: float = 2.0, max_queue: int = 128,
+                 min_bucket: int = 16, max_bucket: int = 1024,
+                 max_models: int = 8):
+        self.registry = ModelRegistry(max_models=max_models)
+        self.engine = BucketedPredictor(min_bucket=min_bucket,
+                                        max_bucket=max_bucket)
+        self.max_batch_size = int(max_batch_size)
+        self.max_wait_ms = float(max_wait_ms)
+        self.max_queue = int(max_queue)
+        self._batchers: Dict[str, MicroBatcher] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+
+    @classmethod
+    def from_config(cls, config) -> "Server":
+        """Build from a Config carrying the serve_* parameters."""
+        return cls(max_batch_size=config.serve_max_batch_size,
+                   max_wait_ms=config.serve_max_wait_ms,
+                   max_queue=config.serve_max_queue,
+                   min_bucket=config.serve_min_bucket,
+                   max_bucket=config.serve_max_bucket,
+                   max_models=config.serve_max_models)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    def load_model(self, name: str, booster=None,
+                   model_file: Optional[str] = None,
+                   model_str: Optional[str] = None) -> ModelEntry:
+        with global_timer.timeit("serve_model_load"):
+            entry = self.registry.load(name, booster=booster,
+                                       model_file=model_file,
+                                       model_str=model_str)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("server is closed")
+            if name not in self._batchers:
+                self._batchers[name] = MicroBatcher(
+                    self._make_runner(name),
+                    max_batch_size=self.max_batch_size,
+                    max_wait_ms=self.max_wait_ms,
+                    max_queue=self.max_queue, name=name)
+        return entry
+
+    def refresh_model(self, name: str, booster=None,
+                      model_file: Optional[str] = None,
+                      model_str: Optional[str] = None) -> ModelEntry:
+        """Swap in a new model version; clears a degraded flag."""
+        if name not in self.registry:
+            raise LightGBMError(f"model '{name}' is not loaded")
+        return self.load_model(name, booster=booster,
+                               model_file=model_file, model_str=model_str)
+
+    def evict_model(self, name: str) -> bool:
+        with self._lock:
+            batcher = self._batchers.pop(name, None)
+        if batcher is not None:
+            batcher.close()
+        return self.registry.evict(name)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            batchers, self._batchers = dict(self._batchers), {}
+        for b in batchers.values():
+            b.close()
+        for name in self.registry.names():
+            self.registry.evict(name)
+
+    def __enter__(self) -> "Server":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------------
+    # request path
+    def predict(self, name: str, X, raw_score: bool = False,
+                timeout: Optional[float] = None) -> np.ndarray:
+        """Score one request; blocks until its coalesced batch lands.
+
+        Matches `Booster.predict(X, raw_score=raw_score)` output shape
+        and values. Raises OverloadError when shed by admission
+        control."""
+        return self.predict_async(name, X, raw_score=raw_score) \
+            .result(timeout=timeout)
+
+    def predict_async(self, name: str, X,
+                      raw_score: bool = False) -> Future:
+        """Non-blocking predict: a Future of the converted scores."""
+        entry = self.registry.get(name)
+        t0 = time.perf_counter()
+        X = np.asarray(X, np.float64)
+        if X.ndim == 1:
+            X = X[None, :]
+        out: Future = Future()
+        if not entry.forest.supported or entry.degraded:
+            self._host_resolve(entry, X, raw_score, t0, out)
+            return out
+        with global_timer.timeit("serve_bin_rows"):
+            bins = entry.forest.bin_rows(X)
+        try:
+            raw_future = self._batchers[name].submit(bins)
+        except OverloadError:
+            entry.metrics.record_shed()
+            raise
+        def _finish(fut: Future) -> None:
+            try:
+                raw = fut.result()
+            except Exception as exc:
+                # device failure: degrade this entry to the host path
+                entry.degraded = True
+                entry.metrics.record_error()
+                Log.warning(
+                    f"serving model '{name}': device predict failed "
+                    f"({exc}); falling back to host predict")
+                self._host_resolve(entry, X, raw_score, t0, out)
+                return
+            try:
+                res = entry.forest.convert_raw(raw, raw_score=raw_score)
+            except Exception as exc:
+                out.set_exception(exc)
+                return
+            entry.metrics.record_request(len(X), time.perf_counter() - t0)
+            out.set_result(res)
+        raw_future.add_done_callback(_finish)
+        return out
+
+    def _host_resolve(self, entry: ModelEntry, X: np.ndarray,
+                      raw_score: bool, t0: float, out: Future) -> None:
+        """Serve via Booster/HostModel predict (CPU fallback path)."""
+        try:
+            with global_timer.timeit("serve_host_fallback"):
+                res = entry.booster.predict(X, raw_score=raw_score)
+        except Exception as exc:
+            entry.metrics.record_error()
+            out.set_exception(exc)
+            return
+        entry.metrics.record_request(len(X), time.perf_counter() - t0,
+                                     fallback=True)
+        out.set_result(res)
+
+    def _make_runner(self, name: str):
+        def run(bins: np.ndarray) -> np.ndarray:
+            entry = self.registry.get(name)
+            return self.engine.predict_raw(entry.forest, bins,
+                                           metrics=entry.metrics)
+        return run
+
+    # test/ops hook: the model's queue (pause/resume/queue_depth)
+    def batcher(self, name: str) -> MicroBatcher:
+        return self._batchers[name]
+
+    # ------------------------------------------------------------------
+    # metrics
+    def metrics_snapshot(self, name: Optional[str] = None) -> Dict:
+        """JSON-able snapshot: per-model request metrics + engine-wide
+        bucket-cache counters + serve_* timer phase totals."""
+        names = [name] if name is not None else self.registry.names()
+        models = {}
+        for nm in names:
+            entry = self.registry.get(nm)
+            snap = entry.metrics.snapshot()
+            snap.update(self.engine.counters_for(entry.forest))
+            snap["version"] = entry.version
+            snap["degraded"] = entry.degraded
+            snap["device_resident"] = entry.forest.supported
+            with self._lock:
+                batcher = self._batchers.get(nm)
+            if batcher is not None:
+                snap["queue_depth"] = batcher.queue_depth()
+                snap["coalesced_batches"] = batcher.batch_count
+                snap["coalesced_requests"] = batcher.coalesced_requests
+            models[nm] = snap
+        return {
+            "models": models,
+            "engine": {
+                "compile_count": self.engine.compile_count,
+                "bucket_cache_hits": self.engine.hit_count,
+                "device_batches": self.engine.device_batches,
+                "min_bucket": self.engine.min_bucket,
+                "max_bucket": self.engine.max_bucket,
+                "max_compilations_per_model":
+                    max_compilations(self.engine.max_bucket),
+            },
+            "timers": timer_totals(),
+        }
+
+    def save_metrics(self, path: str) -> None:
+        with open(path, "w") as fh:
+            json.dump(self.metrics_snapshot(), fh, indent=2)
+            fh.write("\n")

@@ -113,8 +113,13 @@ class TestBenchSurvivesFaults:
         assert parsed["value"] > 0, err[-2000:]
         # the record schema is stable even on degraded runs: every key
         # a round-over-round comparison indexes is present
-        for key in ("vs_baseline", "vs_single_core", "unit"):
+        for key in ("vs_baseline", "vs_single_core", "unit",
+                    "serve_qps", "serve_p50_ms", "serve_p95_ms",
+                    "serve_p99_ms", "serve_rows_per_sec",
+                    "serve_buckets_compiled", "serve_bucket_hits"):
             assert key in parsed, key
+        # the serve path must have produced a live measurement too
+        assert parsed["serve_qps"] > 0, err[-2000:]
 
     def test_fault_above_train_many_mid_measurement(self):
         # fault that escapes train_many: bench must re-probe, rebuild

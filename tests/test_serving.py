@@ -1,0 +1,439 @@
+"""Serving engine tests: registry lifecycle, bucket cache bounds,
+padded-row bit-identity, micro-batch coalescing, overload shedding,
+CPU-fallback parity, metrics snapshot schema, CLI task=serve."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serving import (BucketedPredictor, MicroBatcher,
+                                  ModelRegistry, OverloadError, Server,
+                                  build_device_forest, max_compilations,
+                                  next_bucket)
+from tests.conftest import make_binary, make_multiclass, make_regression
+
+RTOL, ATOL = 1e-5, 1e-7
+
+
+def _train(objective="binary", n=400, f=8, seed=0, rounds=8, **extra):
+    if objective == "multiclass":
+        X, y = make_multiclass(n=n, f=f, k=3, seed=seed)
+        params = {"objective": "multiclass", "num_class": 3}
+    elif objective == "regression":
+        X, y = make_regression(n=n, f=f, seed=seed)
+        params = {"objective": "regression"}
+    else:
+        X, y = make_binary(n=n, f=f, seed=seed)
+        params = {"objective": "binary"}
+    params.update({"num_leaves": 15, "min_data_in_leaf": 5,
+                   "verbosity": -1}, **extra)
+    bst = lgb.train(params, lgb.Dataset(X, label=y),
+                    num_boost_round=rounds)
+    return bst, X, y
+
+
+@pytest.fixture(scope="module")
+def binary_model():
+    return _train("binary")
+
+
+# ---------------------------------------------------------------------------
+# shape bucketing
+
+
+def test_next_bucket_and_bound():
+    assert next_bucket(1, 4, 64) == 4
+    assert next_bucket(4, 4, 64) == 4
+    assert next_bucket(5, 4, 64) == 8
+    assert next_bucket(64, 4, 64) == 64
+    assert next_bucket(1000, 4, 64) == 64   # clamped: engine chunks
+    assert max_compilations(64) == 7        # log2(64) + 1
+    assert max_compilations(1) == 2
+
+
+def test_bucket_cache_bounds_compilations(binary_model):
+    """Mixed batch sizes 1..N hit at most log2(max_bucket)+1 buckets,
+    and the compile counter stops growing after warmup."""
+    bst, X, _ = binary_model
+    forest = bst.device_forest()
+    engine = BucketedPredictor(min_bucket=4, max_bucket=64)
+    sizes = [1, 2, 3, 5, 9, 17, 33, 64, 150, 400, 7, 40, 1, 64]
+    for s in sizes:
+        engine.predict_raw(forest, forest.bin_rows(X[:s]))
+    bound = max_compilations(64)
+    assert engine.compile_count <= bound
+    # warmup done: every bucket has been seen, so replaying the stream
+    # is pure cache hits
+    before = engine.compile_count
+    for s in sizes:
+        engine.predict_raw(forest, forest.bin_rows(X[:s]))
+    assert engine.compile_count == before
+    assert engine.hit_count > 0
+
+
+def test_padded_rows_bit_identical(binary_model):
+    """Bucket padding is invisible: real rows of a padded batch equal
+    the unpadded batch bit-for-bit (satellite: learner/predict.py
+    row_valid masking)."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.learner.predict import predict_binned_forest
+
+    bst, X, _ = binary_model
+    forest = bst.device_forest()
+    bins = forest.bin_rows(X[:37])
+    unpadded = np.asarray(predict_binned_forest(
+        forest.stacked, forest.tree_class, jnp.asarray(bins),
+        forest.num_bins, forest.missing_is_nan,
+        num_outputs=forest.num_outputs))
+    padded_bins = np.concatenate(
+        [bins, np.zeros((64 - 37, bins.shape[1]), bins.dtype)])
+    valid = jnp.asarray(np.arange(64) < 37)
+    padded = np.asarray(predict_binned_forest(
+        forest.stacked, forest.tree_class, jnp.asarray(padded_bins),
+        forest.num_bins, forest.missing_is_nan,
+        num_outputs=forest.num_outputs, row_valid=valid))
+    assert np.array_equal(padded[:37], unpadded)     # bit-identical
+    assert np.all(padded[37:] == 0.0)                # pad rows inert
+
+
+# ---------------------------------------------------------------------------
+# registry lifecycle
+
+
+def test_registry_load_get_evict(binary_model):
+    bst, _, _ = binary_model
+    reg = ModelRegistry(max_models=4)
+    entry = reg.load("m", booster=bst)
+    assert entry.version == 1 and entry.forest.supported
+    assert "m" in reg and len(reg) == 1
+    assert reg.get("m") is entry
+    assert reg.evict("m") is True
+    assert reg.evict("m") is False
+    with pytest.raises(lgb.LightGBMError):
+        reg.get("m")
+
+
+def test_registry_refresh_bumps_version(binary_model):
+    bst, _, _ = binary_model
+    reg = ModelRegistry()
+    reg.load("m", booster=bst)
+    e2 = reg.refresh("m", booster=bst)
+    assert e2.version == 2
+    with pytest.raises(lgb.LightGBMError):
+        reg.refresh("ghost", booster=bst)
+
+
+def test_registry_lru_capacity(binary_model):
+    bst, _, _ = binary_model
+    reg = ModelRegistry(max_models=2)
+    reg.load("a", booster=bst)
+    reg.load("b", booster=bst)
+    reg.get("a")                      # b becomes LRU
+    reg.load("c", booster=bst)
+    assert reg.names() == ["a", "c"]
+
+
+def test_registry_load_from_model_str(binary_model, tmp_path):
+    bst, X, _ = binary_model
+    reg = ModelRegistry()
+    entry = reg.load("s", model_str=bst.model_to_string())
+    assert entry.forest.supported
+    path = tmp_path / "model.txt"
+    bst.save_model(str(path))
+    entry2 = reg.load("f", model_file=str(path))
+    assert entry2.forest.num_trees == entry.forest.num_trees
+
+
+def test_device_forest_memoized_and_invalidated():
+    X, y = make_binary(n=300, f=6, seed=3)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, ds, num_boost_round=2,
+                    keep_training_booster=True)
+    f1 = bst.device_forest()
+    assert bst.device_forest() is f1          # memoized
+    bst.update()                              # mutation invalidates
+    f2 = bst.device_forest()
+    assert f2 is not f1
+    assert f2.num_trees == f1.num_trees + 1
+
+
+# ---------------------------------------------------------------------------
+# micro-batching
+
+
+def test_microbatcher_coalesces_in_fifo_order():
+    calls = []
+
+    def run(bins):
+        calls.append(len(bins))
+        return bins.astype(np.float32) * 2.0
+
+    b = MicroBatcher(run, max_batch_size=100, max_wait_ms=50.0,
+                     max_queue=16, name="t")
+    try:
+        b.pause()
+        reqs = [np.full((i + 1, 2), i, np.int32) for i in range(4)]
+        futs = [b.submit(r) for r in reqs]
+        assert b.queue_depth() == 4
+        b.resume()
+        outs = [f.result(timeout=10) for f in futs]
+        # one coalesced device batch served all four requests...
+        assert calls == [sum(len(r) for r in reqs)]
+        assert b.batch_count == 1 and b.coalesced_requests == 4
+        # ...and each caller got exactly its slice, in submit order
+        for i, (r, o) in enumerate(zip(reqs, outs)):
+            assert o.shape[0] == len(r)
+            assert np.all(o == 2.0 * i)
+    finally:
+        b.close()
+
+
+def test_microbatcher_respects_max_batch_size():
+    calls = []
+
+    def run(bins):
+        calls.append(len(bins))
+        return bins.astype(np.float32)
+
+    b = MicroBatcher(run, max_batch_size=5, max_wait_ms=50.0, name="t")
+    try:
+        b.pause()
+        futs = [b.submit(np.zeros((3, 1), np.int32)) for _ in range(3)]
+        b.resume()
+        for f in futs:
+            f.result(timeout=10)
+        # 3+3 > 5, so the first batch holds one request... but any split
+        # preserving request atomicity and order is acceptable
+        assert sum(calls) == 9
+        assert all(c <= 5 or c == 3 for c in calls)
+        assert len(calls) >= 2
+    finally:
+        b.close()
+
+
+def test_microbatcher_sheds_past_queue_depth():
+    def run(bins):
+        return bins.astype(np.float32)
+
+    b = MicroBatcher(run, max_batch_size=8, max_wait_ms=5.0,
+                     max_queue=2, name="t")
+    try:
+        b.pause()                      # worker frozen: queue only fills
+        b.submit(np.zeros((1, 1), np.int32))
+        b.submit(np.zeros((1, 1), np.int32))
+        with pytest.raises(OverloadError):
+            b.submit(np.zeros((1, 1), np.int32))
+        assert b.shed_count == 1
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# the Server facade
+
+
+def test_server_parity_mixed_sizes(binary_model):
+    bst, X, _ = binary_model
+    with Server(min_bucket=4, max_bucket=64, max_wait_ms=1.0) as srv:
+        srv.load_model("m", booster=bst)
+        lo = 0
+        for s in [1, 3, 17, 64, 120, 2, 33]:
+            sl = X[lo % 200: lo % 200 + s]
+            got = srv.predict("m", sl)
+            ref = bst.predict(sl)
+            assert got.shape == ref.shape
+            np.testing.assert_allclose(got, ref, rtol=RTOL, atol=ATOL)
+            lo += s
+        snap = srv.metrics_snapshot("m")["models"]["m"]
+        assert snap["buckets_compiled"] <= snap["max_compilations"]
+
+
+def test_server_parity_multiclass_and_raw():
+    bst, X, _ = _train("multiclass", n=300, rounds=4)
+    with Server(min_bucket=4, max_bucket=64) as srv:
+        srv.load_model("mc", booster=bst)
+        got = srv.predict("mc", X[:29])
+        ref = bst.predict(X[:29])
+        assert got.shape == ref.shape == (29, 3)
+        np.testing.assert_allclose(got, ref, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(
+            srv.predict("mc", X[:9], raw_score=True),
+            bst.predict(X[:9], raw_score=True), rtol=RTOL, atol=1e-6)
+
+
+def test_server_parity_categorical_nan_unseen():
+    r = np.random.RandomState(7)
+    X = r.randn(400, 5)
+    X[:, 2] = r.randint(0, 12, 400)
+    X[r.rand(400) < 0.15, 0] = np.nan
+    y = ((X[:, 2] % 3 == 0) + 0.1 * np.nan_to_num(X[:, 0])) \
+        .astype(np.float32)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "min_data_in_leaf": 5, "verbosity": -1},
+                    lgb.Dataset(X, label=y, categorical_feature=[2]),
+                    num_boost_round=6)
+    Xq = X[:60].copy()
+    Xq[0, 2] = 99          # unseen category -> right child
+    Xq[1, 2] = np.nan      # NaN category -> right child
+    with Server(min_bucket=4, max_bucket=64) as srv:
+        srv.load_model("cat", booster=bst)
+        np.testing.assert_allclose(srv.predict("cat", Xq),
+                                   bst.predict(Xq), rtol=RTOL, atol=ATOL)
+
+
+def test_server_file_loaded_model_parity(binary_model, tmp_path):
+    """A model re-loaded from text (no training BinMappers) serves via
+    threshold-reconstruction binning with full parity."""
+    bst, X, _ = binary_model
+    path = tmp_path / "m.txt"
+    bst.save_model(str(path))
+    with Server(min_bucket=4, max_bucket=64) as srv:
+        srv.load_model("f", model_file=str(path))
+        np.testing.assert_allclose(srv.predict("f", X[:77]),
+                                   bst.predict(X[:77]),
+                                   rtol=RTOL, atol=ATOL)
+
+
+def test_server_cpu_fallback_parity(binary_model, monkeypatch):
+    """Device failure degrades to the host predict path; results still
+    exactly match Booster.predict."""
+    bst, X, _ = binary_model
+    with Server(min_bucket=4, max_bucket=64) as srv:
+        srv.load_model("m", booster=bst)
+
+        def boom(*a, **k):
+            raise RuntimeError("device lost")
+
+        monkeypatch.setattr(srv.engine, "predict_raw", boom)
+        got = srv.predict("m", X[:21])
+        ref = bst.predict(X[:21])
+        assert np.array_equal(got, ref)   # identical: same host code path
+        snap = srv.metrics_snapshot("m")["models"]["m"]
+        assert snap["degraded"] is True
+        assert snap["fallback_count"] >= 1 and snap["errors"] >= 1
+        # degraded entries skip the device entirely from then on
+        got2 = srv.predict("m", X[:5])
+        assert np.array_equal(got2, bst.predict(X[:5]))
+        # refresh clears the degradation
+        monkeypatch.undo()
+        srv.refresh_model("m", booster=bst)
+        assert srv.metrics_snapshot("m")["models"]["m"]["degraded"] is False
+
+
+def test_server_unsupported_model_host_path():
+    """Linear-leaf models cannot be served from bins; the server falls
+    back to host predict transparently."""
+    X, y = make_regression(n=300, f=5, seed=2)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "linear_tree": True, "verbosity": -1},
+                    lgb.Dataset(X, label=y, params={"linear_tree": True}),
+                    num_boost_round=3)
+    forest = bst.device_forest()
+    assert not forest.supported and "linear" in forest.unsupported_reason
+    with Server() as srv:
+        srv.load_model("lin", booster=bst)
+        got = srv.predict("lin", X[:31])
+        assert np.array_equal(got, bst.predict(X[:31]))
+        snap = srv.metrics_snapshot("lin")["models"]["lin"]
+        assert snap["device_resident"] is False
+        assert snap["fallback_count"] == 1
+
+
+def test_server_shedding_and_metrics(binary_model):
+    bst, X, _ = binary_model
+    with Server(min_bucket=4, max_bucket=64, max_queue=2,
+                max_wait_ms=50.0) as srv:
+        srv.load_model("m", booster=bst)
+        srv.batcher("m").pause()
+        futs = [srv.predict_async("m", X[:3]) for _ in range(2)]
+        with pytest.raises(OverloadError):
+            srv.predict("m", X[:3])
+        srv.batcher("m").resume()
+        for f in futs:
+            f.result(timeout=10)
+        snap = srv.metrics_snapshot("m")["models"]["m"]
+        assert snap["shed_count"] == 1
+        assert snap["requests"] == 2
+
+
+def test_server_evict_and_metrics_schema(binary_model):
+    bst, X, _ = binary_model
+    with Server(min_bucket=4, max_bucket=32) as srv:
+        srv.load_model("m", booster=bst)
+        srv.predict("m", X[:10])
+        srv.predict("m", X[:10])
+        snap = srv.metrics_snapshot()
+        m = snap["models"]["m"]
+        for key in ("requests", "rows", "qps", "rows_per_sec", "p50_ms",
+                    "p95_ms", "p99_ms", "bucket_cache_hits",
+                    "compile_count", "shed_count", "fallback_count",
+                    "queue_depth", "version"):
+            assert key in m, key
+        assert m["requests"] == 2 and m["rows"] == 20
+        assert m["p50_ms"] is not None
+        assert snap["engine"]["max_compilations_per_model"] == \
+            max_compilations(32)
+        json.dumps(snap)                      # snapshot is JSON-able
+        assert srv.evict_model("m") is True
+        assert srv.evict_model("m") is False
+        with pytest.raises(lgb.LightGBMError):
+            srv.predict("m", X[:2])
+
+
+def test_server_save_metrics(binary_model, tmp_path):
+    bst, X, _ = binary_model
+    path = tmp_path / "metrics.json"
+    with Server(min_bucket=4, max_bucket=32) as srv:
+        srv.load_model("m", booster=bst)
+        srv.predict("m", X[:5])
+        srv.save_metrics(str(path))
+    snap = json.loads(path.read_text())
+    assert snap["models"]["m"]["requests"] == 1
+    assert "timers" in snap
+
+
+def test_build_device_forest_no_trees():
+    from lightgbm_tpu.tree import HostModel
+    m = HostModel()
+    m.max_feature_idx = 3
+    forest = build_device_forest(m)
+    assert not forest.supported
+
+
+# ---------------------------------------------------------------------------
+# CLI task=serve
+
+
+def test_cli_task_serve(tmp_path):
+    from lightgbm_tpu.cli import main as cli_main
+
+    X, y = make_binary(n=200, f=5, seed=4)
+    data = np.column_stack([y, X])
+    train_file = tmp_path / "train.csv"
+    np.savetxt(train_file, data, delimiter=",", fmt="%.8g")
+    model_file = tmp_path / "model.txt"
+    assert cli_main([f"data={train_file}", "task=train",
+                     "objective=binary", "num_leaves=7",
+                     "num_iterations=3", "verbosity=-1", "min_data=5",
+                     f"output_model={model_file}"]) == 0
+    out_file = tmp_path / "preds.tsv"
+    assert cli_main([f"data={train_file}", "task=serve",
+                     f"input_model={model_file}",
+                     f"output_result={out_file}", "verbosity=-1",
+                     "max_bucket=64", "min_bucket=4"]) == 0
+    preds = np.loadtxt(out_file)
+    assert preds.shape == (200,)
+    bst = lgb.Booster(model_file=str(model_file))
+    np.testing.assert_allclose(preds, bst.predict(X), rtol=RTOL,
+                               atol=1e-6)
+    metrics_path = str(out_file) + ".metrics.json"
+    assert os.path.exists(metrics_path)
+    snap = json.loads(open(metrics_path).read())
+    m = snap["models"]["default"]
+    assert m["rows"] == 200 and m["shed_count"] == 0
+    assert m["buckets_compiled"] <= snap["engine"][
+        "max_compilations_per_model"]
