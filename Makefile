@@ -7,10 +7,15 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-all bench install
+.PHONY: test test-slow test-all faults bench install
 
 test:
 	$(PY) -m pytest tests/ -x -q
+
+# the fault-injection tier: every registered reliability site fired and
+# recovered (tests/test_reliability.py, docs/Reliability.md)
+faults:
+	$(PY) -m pytest tests/ -x -q -m faults
 
 # batched: the whole slow tier in ONE pytest process hard-crashed the
 # interpreter twice (not OOM; see TESTS.md round 4) — per-batch runs

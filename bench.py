@@ -263,7 +263,11 @@ def main():
               "serve_qps": 0.0, "serve_rows_per_sec": 0.0,
               "serve_p50_ms": 0.0, "serve_p95_ms": 0.0,
               "serve_p99_ms": 0.0, "serve_buckets_compiled": 0,
-              "serve_bucket_hits": 0}
+              "serve_bucket_hits": 0,
+              # reliability-counter schema (overwritten from the live
+              # counters at the end of the run)
+              "device_retries": 0, "fallbacks": 0, "guard_trips": 0,
+              "checkpoint_saves": 0, "checkpoint_failures": 0}
     block_times = []
     block_trees = min(BLOCK_TREES, BENCH_TREES)
     bench = None
@@ -313,6 +317,15 @@ def main():
         result["vs_single_core"] = round(
             median_rate / SINGLE_CORE_TREES_PER_SEC, 3)
     _serve_bench(bench, result)
+    try:
+        # reliability counters (lightgbm_tpu/reliability/): how degraded
+        # this record is — retries, fused->per-iter / device->host
+        # fallbacks, guard trips — rides in the same JSON line
+        from lightgbm_tpu.reliability import counters
+        result.update(counters.snapshot())
+    except Exception as exc:
+        print(f"# reliability counters unavailable: {exc}",
+              file=sys.stderr)
     return result, block_times, block_trees, bench
 
 

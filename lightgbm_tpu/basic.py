@@ -614,6 +614,31 @@ class Booster:
         self.gbdt.rollback_one_iter()
         return self
 
+    # ------------------------------------------------------------------
+    # training-state serialization (reliability/checkpoint.py bundles)
+    def _training_state(self):
+        """(json-state, arrays) capturing everything `model_to_string`
+        does NOT: the exact f32 score state, RNG stream position,
+        mid-period bagging mask and boost-from-average flags. Together
+        with the saved model text this is sufficient for
+        `_restore_training_state` to continue the run bit-for-bit."""
+        state, arrays = self.gbdt.training_state()
+        state["best_iteration"] = int(self.best_iteration)
+        return state, arrays
+
+    def _restore_training_state(self, ckpt) -> None:
+        """Restore from a `reliability.checkpoint.CheckpointState`.
+
+        The caller (engine.train resume path) has already attached the
+        checkpointed model as `_base_model`; this restores the live
+        training state on top of it."""
+        self._model = None
+        self.gbdt.restore_training_state(ckpt.iteration, ckpt.state,
+                                         ckpt.arrays)
+        best = int(ckpt.state.get("best_iteration", -1))
+        if best >= 0:
+            self.best_iteration = best
+
     def current_iteration(self) -> int:
         if self.gbdt is not None:
             n = self.gbdt.current_iteration()

@@ -32,7 +32,8 @@ from ..learner.renew import renew_tree_output
 from ..learner.split import SplitHyperParams
 from ..metrics import Metric
 from ..objectives import ObjectiveFunction
-from ..utils.log import Log
+from ..reliability import counters, faults, guards, retry_call
+from ..utils.log import Log, LightGBMError
 from ..utils.timer import global_timer
 from ..utils.file_io import open_file
 
@@ -42,23 +43,21 @@ _FAULT_ENV = "LGBM_TPU_INJECT_FUSED_FAULT"
 
 
 def _maybe_inject_fused_fault(env: str = _FAULT_ENV):
-    """Test hook: fail upcoming fused dispatches on request, so the
-    bench/fallback robustness paths can be exercised without a real
-    device outage. Env format: "N" (fail the next N dispatches) or
-    "S:N" (let S dispatches through, then fail N)."""
-    val = os.environ.get(env, "")
-    if not val:
-        return
-    skip, _, fail = val.partition(":")
-    if not fail:
-        skip, fail = "0", skip
-    skip_n, fail_n = int(skip), int(fail)
-    if skip_n > 0:
-        os.environ[env] = "%d:%d" % (skip_n - 1, fail_n)
-        return
-    if fail_n > 0:
-        os.environ[env] = "0:%d" % (fail_n - 1)
-        raise RuntimeError("injected fused-dispatch fault (test hook)")
+    """Fail upcoming fused dispatches on request, so the bench/fallback
+    robustness paths can be exercised without a real device outage. Env
+    format: "N" (fail the next N dispatches) or "S:N" (let S dispatches
+    through, then fail N).
+
+    Shim over the unified fault registry (reliability/faults.py): the
+    env var is only an initial-schedule *source* — the countdown lives
+    in the in-process registry and the environment is never mutated
+    (the old counter-in-env leaked state across tests and raced under
+    threads). The default env maps to the registered `fused_dispatch`
+    site; other env names (bench.py's block-fault hook) get their own
+    ad-hoc site."""
+    site = "fused_dispatch" if env == _FAULT_ENV else f"env:{env}"
+    faults.schedule_from_env(site, env)
+    faults.inject(site)
 
 
 class GBDT:
@@ -88,6 +87,11 @@ class GBDT:
         self.valid_metrics: List[List[Metric]] = []
         self.best_iter = -1
         self._rng_key = jax.random.PRNGKey(int(config.seed))
+        # checkpoint resume: iter_ stays ABSOLUTE over the merged model
+        # (RNG fold-ins and bagging cadence key off it) while the trees
+        # list only holds this instance's trees; the offset reconciles
+        # the two for current_iteration()/rollback accounting
+        self._iter_offset = 0
 
         if train_set is not None:
             self._setup_train(train_set)
@@ -588,6 +592,27 @@ class GBDT:
             interpret=getattr(self, "_mxu_interpret", False))
 
     def _grow(self, g, h, cnt, feature_mask):
+        """Growth dispatch with fault injection + retry (sites
+        "histogram_build" and, for sharded growth, "collective_psum").
+        Injection is host-side: inside the traced grower a raise would
+        bake into the compiled program. Retrying `_grow_impl` is safe
+        because it only mutates state (CEGB feat_used) after the
+        dispatch returns."""
+        cfg = self.config
+
+        def _attempt():
+            faults.inject("histogram_build")
+            if self._grower is not None:
+                from ..parallel.comm import check_collective_fault
+                check_collective_fault()
+            return self._grow_impl(g, h, cnt, feature_mask)
+
+        return retry_call(_attempt, attempts=cfg.retry_max_attempts,
+                          backoff_ms=cfg.retry_backoff_ms,
+                          backoff_max_ms=cfg.retry_backoff_max_ms,
+                          site="histogram_build")
+
+    def _grow_impl(self, g, h, cnt, feature_mask):
         """Dispatch serial vs sharded growth; returns (tree, row_node[:N])."""
         cfg = self.config
         needs_rng = (self.hp.extra_trees or
@@ -798,6 +823,24 @@ class GBDT:
                     init_scores[cls] = self._boost_from_average(cls)
                 gradients, hessians = self.objective.get_gradients(
                     self.train_score)
+
+        guard = cfg.guard_nonfinite
+        prev_scores = None
+        if guard != "off":
+            # pre-growth rail: non-finite gradients (exploding custom
+            # objective, corrupted scores) poison every later iteration
+            if not guards.all_finite(gradients, hessians):
+                gradients, hessians = self._guard_gradients(
+                    guard, gradients, hessians)
+                if gradients is None:      # skip_iteration consumed it
+                    return False
+            # reference for the post-growth rail: scores are immutable
+            # JAX arrays, so stashing them is a pair of references, and
+            # restoring beats arithmetic rollback (subtracting a NaN
+            # tree cannot un-NaN a score)
+            prev_scores = (self.train_score,
+                           list(getattr(self, "valid_scores", []) or []))
+
         with global_timer.timeit("bagging"):
             grad, hess, cnt = self._bagging(gradients, hessians)
 
@@ -824,7 +867,8 @@ class GBDT:
             # predictions unaffected). Subclasses that average over
             # iteration count (RF) set _exact_stop_poll to keep the
             # reference's immediate stop.
-            if len(self.trees) < k or self._exact_stop_poll:
+            if (self.iter_ == 0 and len(self.trees) < k) or \
+                    self._exact_stop_poll:
                 nleaves = int(tree.num_leaves)
                 stop_hint = nleaves <= 1
             else:
@@ -888,7 +932,7 @@ class GBDT:
                             tree.split_feature < 0,
                             lin.const + init_scores[cls], lin.const))
             else:
-                if len(self.trees) < k:
+                if self.iter_ == 0 and len(self.trees) < k:
                     if self.objective is not None and \
                             not cfg.boost_from_average and \
                             not self._has_init_score:
@@ -899,7 +943,68 @@ class GBDT:
             self.tree_class.append(cls)
             self.linear_models.append(lin)
         self.iter_ += 1
+        if guard != "off" and not guards.all_finite(
+                self.train_score,
+                *[self._guarded_tree_values(t) for t in self.trees[-k:]]):
+            guards.trip("split gains/scores", guard, self.iter_ - 1)
+            if guard in ("skip_iteration", "rollback"):
+                # discard the offending iteration by exact restoration
+                for _ in range(k):
+                    self.trees.pop()
+                    self.tree_class.pop()
+                    self.linear_models.pop()
+                self.train_score = prev_scores[0]
+                for i, s in enumerate(prev_scores[1]):
+                    self.valid_scores[i] = s
+                self.iter_ -= 1
+                if guard == "skip_iteration":
+                    # keep the iteration slot (constant zero trees) so
+                    # tree counts stay aligned with the boosting round
+                    for cls in range(k):
+                        self.trees.append(self._constant_tree(0.0))
+                        self.tree_class.append(cls)
+                        self.linear_models.append(None)
+                    self.iter_ += 1
         return not should_continue
+
+    @staticmethod
+    def _guarded_tree_values(tree):
+        """Leaf outputs of `tree`'s *valid* nodes only: slots past
+        num_nodes and internal-node slots hold uninitialised padding
+        (legitimately non-finite), so the guard must not read them."""
+        idx = jnp.arange(tree.leaf_value.shape[0])
+        valid = (idx < tree.num_nodes) & tree.is_leaf
+        return jnp.where(valid, tree.leaf_value, 0.0)
+
+    def _guard_gradients(self, guard, gradients, hessians):
+        """Pre-growth non-finite rail (guard_nonfinite policies).
+        Returns usable (gradients, hessians), or (None, None) when the
+        skip_iteration policy consumed the whole iteration."""
+        guards.trip("gradients/hessians", guard, self.iter_)
+        k = self.num_tree_per_iteration
+        if guard == "rollback" and self.iter_ > self._iter_offset and \
+                self.objective is not None:
+            # the bad gradients were computed from the current scores:
+            # drop the iteration that produced them (reference
+            # Boosting::RollbackOneIter) and recompute
+            self.rollback_one_iter()
+            gradients, hessians = self.objective.get_gradients(
+                self.train_score)
+            if guards.all_finite(gradients, hessians):
+                return gradients, hessians
+            guards.trip("gradients/hessians after rollback", guard,
+                        self.iter_)
+        if guard == "skip_iteration":
+            # keep the iteration slot: constant zero trees contribute
+            # nothing but keep tree counts aligned with boosting rounds
+            for cls in range(k):
+                self.trees.append(self._constant_tree(0.0))
+                self.tree_class.append(cls)
+                self.linear_models.append(None)
+            self.iter_ += 1
+            return None, None
+        return (jnp.nan_to_num(gradients, nan=0.0, posinf=0.0, neginf=0.0),
+                jnp.nan_to_num(hessians, nan=0.0, posinf=0.0, neginf=0.0))
 
     def _feature_mask(self) -> jax.Array:
         return self._feature_mask_at(self.iter_)
@@ -933,7 +1038,10 @@ class GBDT:
         consumes pre-drawn keys, and multiclass grows one tree per class
         per step (fused.py)."""
         cfg = self.config
+        # guard rails need per-iteration host checks; the fused scan has
+        # no host boundary to interpose on (docs/Reliability.md)
         return (type(self) is GBDT and cfg.boosting in ("gbdt", "goss")
+                and cfg.guard_nonfinite == "off"
                 and self._grower is None and self._hist_impl == "mxu"
                 and not self._linear
                 and self.objective is not None
@@ -1076,19 +1184,40 @@ class GBDT:
             _seal()
             return stop
         saved_rng = self._rng_key
+        cfg = self.config
+
+        def _attempt():
+            # every attempt rewinds the RNG stream first: whether the
+            # dispatch succeeds on attempt 1 or 3, it must consume the
+            # IDENTICAL key sequence — a transient fault must not
+            # change the trained model
+            self._rng_key = saved_rng
+            try:
+                _maybe_inject_fused_fault()
+                if getattr(self, "_fused_run", None) is None:
+                    self._fused_run = self._build_fused()
+                keys = None
+                if getattr(self, "_fused_needs_keys", False):
+                    # the same _next_key sequence the per-iteration GOSS
+                    # path would draw, pre-drawn as scan inputs
+                    keys = jnp.stack([self._next_key() for _ in range(k)])
+                with global_timer.timeit("tree_train"):
+                    return self._fused_run(
+                        self.train_score,
+                        jnp.asarray(self.iter_, jnp.int32),
+                        k=k, sample_keys=keys)
+            except Exception:
+                self._fused_run = None  # closure may hold dead executables
+                raise
+
         try:
-            _maybe_inject_fused_fault()
-            if getattr(self, "_fused_run", None) is None:
-                self._fused_run = self._build_fused()
-            keys = None
-            if getattr(self, "_fused_needs_keys", False):
-                # the same _next_key sequence the per-iteration GOSS
-                # path would draw, pre-drawn as scan inputs
-                keys = jnp.stack([self._next_key() for _ in range(k)])
-            with global_timer.timeit("tree_train"):
-                score, stacked = self._fused_run(
-                    self.train_score, jnp.asarray(self.iter_, jnp.int32),
-                    k=k, sample_keys=keys)
+            # capped-exponential-backoff retries before degrading: a
+            # transient launch failure should not cost the fused path
+            score, stacked = retry_call(
+                _attempt, attempts=cfg.retry_max_attempts,
+                backoff_ms=cfg.retry_backoff_ms,
+                backoff_max_ms=cfg.retry_backoff_max_ms,
+                site="fused_dispatch")
         except Exception as exc:  # device/compile faults must not kill
             # rewind the RNG stream so the per-iteration fallback draws
             # the IDENTICAL key sequence the fused dispatch consumed —
@@ -1096,6 +1225,7 @@ class GBDT:
             self._rng_key = saved_rng
             self._fused_failures = getattr(self, "_fused_failures", 0) + 1
             self._fused_run = None  # closure may hold dead executables
+            counters.inc("fallbacks")
             if self._fused_failures >= 2:
                 self._fused_disabled = True
             Log.warning(
@@ -1262,7 +1392,9 @@ class GBDT:
     # ------------------------------------------------------------------
     def rollback_one_iter(self) -> None:
         """Drop the last iteration (gbdt.cpp:451-467)."""
-        if self.iter_ == 0:
+        if self.iter_ <= self._iter_offset:
+            # nothing of this instance's own to roll back (checkpointed
+            # base iterations are immutable)
             return
         k = self.num_tree_per_iteration
         for cls in range(k):
@@ -1320,7 +1452,85 @@ class GBDT:
         return self.iter_
 
     def current_iteration(self) -> int:
-        return self.iter_
+        return self.iter_ - self._iter_offset
+
+    # ------------------------------------------------------------------
+    # checkpoint/resume (reliability/checkpoint.py bundles)
+    def training_state(self):
+        """(json-state, arrays) beyond what the model text carries:
+        exact f32 scores, RNG stream position, mid-period bagging mask,
+        boost-from-average flags and the lagged stop-poll hint. With
+        these restored, replaying iterations k..N reproduces an
+        uninterrupted run bit-for-bit (fold-in RNG draws key off the
+        absolute iter_, which resume preserves)."""
+        state = {
+            "boosting": self.config.boosting,
+            "num_class": self.num_class,
+            "shrinkage_rate": float(self.shrinkage_rate),
+            "boosted_from_average": [bool(b) for b in
+                                     self._boosted_from_average],
+            "has_init_score": bool(self._has_init_score),
+            "num_valid": len(getattr(self, "valid_scores", []) or []),
+        }
+        if self._pending_nleaves is not None:
+            # host sync is fine here — checkpointing is already IO-bound
+            state["pending_nleaves"] = int(self._pending_nleaves)
+        arrays = {
+            "train_score": np.asarray(self.train_score),
+            "rng_key": np.asarray(self._rng_key),
+            "bag_mask": np.asarray(self._bag_mask),
+        }
+        for i, s in enumerate(getattr(self, "valid_scores", []) or []):
+            arrays[f"valid_score_{i}"] = np.asarray(s)
+        return state, arrays
+
+    def restore_training_state(self, iteration: int, state: Dict,
+                               arrays: Dict) -> None:
+        """Continue a checkpointed run: `iteration` boosting rounds live
+        in the attached base model; this instance trains the rest from
+        the exact device state the killed run held."""
+        cfg = self.config
+        if int(state.get("num_class", self.num_class)) != self.num_class:
+            raise LightGBMError(
+                "checkpoint num_class=%s does not match num_class=%d" %
+                (state.get("num_class"), self.num_class))
+        if state.get("boosting", cfg.boosting) != cfg.boosting:
+            raise LightGBMError(
+                "checkpoint boosting=%r does not match boosting=%r" %
+                (state.get("boosting"), cfg.boosting))
+        if cfg.boosting not in ("gbdt", "goss"):
+            Log.warning(
+                "resume is exact for gbdt/goss boosting; %r resumes "
+                "best-effort (sampling state beyond the RNG key is "
+                "rebuilt)" % cfg.boosting)
+        if getattr(self, "_cegb_cfg", None) is not None:
+            Log.warning(
+                "cegb feature-used state is not checkpointed; resumed "
+                "CEGB penalties restart from a clean slate")
+        score = jnp.asarray(arrays["train_score"])
+        if score.shape != self.train_score.shape:
+            raise LightGBMError(
+                "checkpoint train_score shape %s does not match the "
+                "training set (%s) — resume needs the same dataset" %
+                (score.shape, self.train_score.shape))
+        self.iter_ = int(iteration)
+        self._iter_offset = int(iteration)
+        self.train_score = score
+        self._rng_key = jnp.asarray(arrays["rng_key"])
+        if "bag_mask" in arrays:
+            self._bag_mask = jnp.asarray(arrays["bag_mask"])
+        self.shrinkage_rate = float(
+            state.get("shrinkage_rate", self.shrinkage_rate))
+        bfa = state.get("boosted_from_average")
+        if bfa is not None:
+            self._boosted_from_average = [bool(b) for b in bfa]
+        if state.get("pending_nleaves") is not None:
+            self._pending_nleaves = jnp.asarray(
+                int(state["pending_nleaves"]), jnp.int32)
+        for i in range(len(getattr(self, "valid_scores", []) or [])):
+            key = f"valid_score_{i}"
+            if key in arrays:
+                self.valid_scores[i] = jnp.asarray(arrays[key])
 
 
 def create_boosting(config: Config, train_set, objective, metrics):

@@ -213,12 +213,24 @@ class Application:
 
             callbacks.append(_snapshot)
         init_model = cfg.input_model if cfg.input_model else None
+        resume_from = None
+        if cfg.checkpoint_period > 0 and cfg.checkpoint_dir:
+            # auto-resume (docs/Reliability.md): a killed task=train run
+            # rerun with the same conf picks up at its last checkpoint;
+            # engine.train adds the periodic checkpoint callback itself
+            from .reliability.checkpoint import latest_checkpoint
+            found = latest_checkpoint(cfg.checkpoint_dir)
+            if found is not None:
+                resume_from = found
+                init_model = None
+                Log.info("Auto-resuming from checkpoint %s", found)
         booster = train_fn(dict(self.params), dtrain,
                            num_boost_round=cfg.num_iterations,
                            valid_sets=valid_sets or None,
                            valid_names=valid_names or None,
                            callbacks=callbacks,
-                           init_model=init_model)
+                           init_model=init_model,
+                           resume_from=resume_from)
         booster.save_model(cfg.output_model)
         Log.info("Finished training, model saved to %s", cfg.output_model)
 

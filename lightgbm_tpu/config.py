@@ -195,6 +195,20 @@ _PARAMS: List[ParamSpec] = [
     _p("serve_max_bucket", int, 1024, ("max_bucket",), lambda v: v > 0),
     _p("serve_max_models", int, 8, (), lambda v: v > 0),
     _p("serve_metrics_file", str, "", ("metrics_file",)),
+    # ---- Reliability (lightgbm_tpu/reliability/, docs/Reliability.md) ----
+    _p("checkpoint_period", int, 0, ("checkpoint_freq", "snapshot_period"),
+       lambda v: v >= 0),
+    _p("checkpoint_dir", str, "", ("checkpoint_path",)),
+    _p("checkpoint_keep", int, 3, ("checkpoint_keep_last",
+                                   "keep_last_checkpoints"),
+       lambda v: v >= 1),
+    _p("guard_nonfinite", str, "off", ("guard_policy", "nonfinite_policy"),
+       lambda v: v in ("off", "warn", "skip_iteration", "rollback", "raise")),
+    _p("retry_max_attempts", int, 3, ("device_retry_attempts",),
+       lambda v: v >= 1),
+    _p("retry_backoff_ms", float, 50.0, ("retry_base_backoff_ms",),
+       lambda v: v >= 0),
+    _p("retry_backoff_max_ms", float, 2000.0, (), lambda v: v >= 0),
     # ---- Convert (config.h:1006-1020) ----
     _p("convert_model_language", str, ""),
     _p("convert_model", str, "gbdt_prediction.cpp",
@@ -413,6 +427,12 @@ class Config:
             full = 1 << min(self.max_depth, 30)
             if self.num_leaves > full:
                 self.num_leaves = full
+        if self.checkpoint_period > 0 and not self.checkpoint_dir:
+            from .utils.log import Log
+            Log.warning(
+                "checkpoint_period > 0 needs checkpoint_dir; "
+                "checkpointing disabled")
+            self.checkpoint_period = 0
         if self.serve_max_bucket < self.serve_min_bucket:
             from .utils.log import Log
             Log.warning(

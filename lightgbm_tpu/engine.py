@@ -38,9 +38,25 @@ def train(params: Dict[str, Any], train_set: Dataset,
           fobj=None, feval=None, init_model=None,
           feature_name="auto", categorical_feature="auto",
           keep_training_booster: bool = False,
-          callbacks: Optional[List] = None) -> Booster:
+          callbacks: Optional[List] = None,
+          resume_from: Optional[str] = None) -> Booster:
     params = copy.deepcopy(params or {})
     num_boost_round = _resolve_num_boost_round(params, num_boost_round)
+    resume_state = None
+    if resume_from is not None:
+        # kill-and-resume (docs/Reliability.md): restore the exact
+        # training state from a checkpoint bundle. Unlike init_model
+        # continuation below — which re-seeds init scores through a
+        # host predict and restarts the RNG stream — resume restores
+        # the checkpointed f32 scores / RNG / bagging state verbatim,
+        # so the finished model is byte-identical to an uninterrupted
+        # run. num_boost_round stays the TOTAL iteration count.
+        if init_model is not None:
+            raise ValueError("resume_from and init_model are exclusive: "
+                             "a checkpoint bundle already carries its model")
+        from .reliability.checkpoint import load_checkpoint
+        resume_state = load_checkpoint(resume_from)
+        init_model = None
     if fobj is not None:
         params["objective"] = "none"
     first_metric_only = bool(params.get("first_metric_only", False))
@@ -115,6 +131,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     _unseed(vd)
 
     booster = Booster(params=params, train_set=train_set)
+    if resume_state is not None:
+        # the checkpointed model's trees ride in front of the resumed
+        # ones exactly like continued training, but WITHOUT init-score
+        # seeding: the restored train_score already contains their
+        # contribution in the exact f32 bits the killed run held
+        base_model = Booster(model_str=resume_state.model_str)
     if base_model is not None:
         booster._base_model = base_model
 
@@ -142,11 +164,29 @@ def train(params: Dict[str, Any], train_set: Dataset,
     for vd, name in zip(reduced_valid_sets, name_valid_sets):
         booster.add_valid(vd, name)
 
+    start_iter = 0
+    if resume_state is not None:
+        booster._restore_training_state(resume_state)
+        start_iter = resume_state.iteration
+        Log.info(f"resuming training from checkpoint "
+                 f"{resume_state.path!r} at iteration {start_iter}")
+
     cbs = set(callbacks or [])
     if params.get("early_stopping_round", 0) and \
             int(params["early_stopping_round"]) > 0:
         cbs.add(callback_mod.early_stopping(
             int(params["early_stopping_round"]), first_metric_only))
+    cfg = booster.config
+    if getattr(cfg, "checkpoint_period", 0) > 0 and cfg.checkpoint_dir \
+            and not any(getattr(cb, "is_checkpoint", False) for cb in cbs):
+        cbs.add(callback_mod.checkpoint(
+            cfg.checkpoint_period, cfg.checkpoint_dir, cfg.checkpoint_keep))
+    if resume_state is not None:
+        history = resume_state.state.get("eval_history")
+        if history:
+            for cb in cbs:
+                if hasattr(cb, "_seed_history"):
+                    cb._seed_history(history)
     callbacks_before = {cb for cb in cbs
                         if getattr(cb, "before_iteration", False)}
     callbacks_after = cbs - callbacks_before
@@ -187,13 +227,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
         for cb in callbacks_after:
             cb(callback_mod.CallbackEnv(
                 model=booster, params=params, iteration=i,
-                begin_iteration=0, end_iteration=num_boost_round,
+                begin_iteration=start_iter, end_iteration=num_boost_round,
                 evaluation_result_list=evaluation_result_list))
         return evaluation_result_list
 
     evaluation_result_list = []
     try:
-        i = 0
+        i = start_iter
         while i < num_boost_round:
             b = min(block, num_boost_round - i) if use_blocks else 1
             if b > 1:
@@ -251,16 +291,18 @@ def train(params: Dict[str, Any], train_set: Dataset,
             for cb in callbacks_before:
                 cb(callback_mod.CallbackEnv(
                     model=booster, params=params, iteration=i,
-                    begin_iteration=0, end_iteration=num_boost_round,
+                    begin_iteration=start_iter, end_iteration=num_boost_round,
                     evaluation_result_list=None))
             booster.update(fobj=fobj)
             evaluation_result_list = _eval_at(i)
             i += 1
     except callback_mod.EarlyStopException as es:
         # with continued training, iteration indexing covers the merged
-        # model (base trees first), matching predict(num_iteration=...)
+        # model (base trees first), matching predict(num_iteration=...).
+        # On resume the loop index is already absolute over the merged
+        # model, so there is no base offset to add.
         base_iters = base_model.current_iteration() \
-            if base_model is not None else 0
+            if base_model is not None and resume_state is None else 0
         booster.best_iteration = base_iters + es.best_iteration + 1
         evaluation_result_list = es.best_score
     if booster.best_iteration < 0:

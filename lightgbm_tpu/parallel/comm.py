@@ -21,7 +21,20 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
-__all__ = ["CommSpec"]
+__all__ = ["CommSpec", "check_collective_fault"]
+
+
+def check_collective_fault() -> None:
+    """Host-side injection hook for the `collective_psum` fault site.
+
+    The collectives themselves run inside shard_map-traced code where a
+    Python raise would bake into the compiled program, so the GBDT
+    growth dispatch calls this at the host boundary before every
+    sharded-grower launch — the point where a real interconnect failure
+    would surface as a dispatch error. Retried/fallback handling lives
+    with the caller (reliability/retry.py)."""
+    from ..reliability import faults
+    faults.inject("collective_psum")
 
 
 @dataclasses.dataclass(frozen=True)

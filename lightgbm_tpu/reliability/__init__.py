@@ -1,0 +1,33 @@
+"""Fault tolerance for training and serving.
+
+Three pillars (see docs/Reliability.md):
+
+- checkpoint/resume: atomic training-state bundles + `train(...,
+  resume_from=)` so a killed run resumes to a model byte-identical to
+  an uninterrupted one (`reliability.checkpoint`);
+- unified fault injection: a registry of named sites with deterministic
+  skip/fail schedules, the single lever robustness tests pull
+  (`reliability.faults`);
+- guard rails + retry: non-finite detection with configurable policy,
+  and capped-exponential-backoff retries at device dispatch boundaries
+  (`reliability.guards`, `reliability.retry`).
+
+Every recovery is counted (`reliability.counters`) so degradation shows
+up in the bench JSON record and the serving metrics snapshot.
+"""
+
+from .counters import ReliabilityCounters, counters
+from .faults import FaultRegistry, InjectedFault, KNOWN_SITES, faults
+from .guards import GUARD_POLICIES, GuardError
+from .retry import retry_call
+from .checkpoint import (CheckpointState, latest_checkpoint,
+                         load_checkpoint, save_checkpoint)
+
+__all__ = [
+    "ReliabilityCounters", "counters",
+    "FaultRegistry", "InjectedFault", "KNOWN_SITES", "faults",
+    "GUARD_POLICIES", "GuardError",
+    "retry_call",
+    "CheckpointState", "latest_checkpoint", "load_checkpoint",
+    "save_checkpoint",
+]

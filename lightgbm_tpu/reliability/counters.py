@@ -1,0 +1,55 @@
+"""Process-wide reliability counters: degradation must be observable.
+
+Every silent recovery path (device retry, fused->per-iteration fallback,
+guard-rail trip, checkpoint write failure) increments a named counter
+here so the bench JSON record and the serving metrics snapshot can
+surface how degraded a run actually was. Mirrors the reference's
+philosophy that a fallback without a log line is a bug — except these
+are machine-readable.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+__all__ = ["ReliabilityCounters", "counters"]
+
+_KEYS = (
+    "device_retries",      # retry_call attempts that followed a failure
+    "fallbacks",           # degraded dispatches (fused->per-iter, device->host)
+    "guard_trips",         # non-finite guard activations
+    "checkpoint_saves",    # successful checkpoint bundles written
+    "checkpoint_failures", # checkpoint writes that failed (training continued)
+)
+
+
+class ReliabilityCounters:
+    """Thread-safe named counters with a stable snapshot schema."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {k: 0 for k in _KEYS}
+
+    def inc(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + int(n)
+
+    def get(self, key: str) -> int:
+        with self._lock:
+            return self._counts.get(key, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        """All keys, always present — consumers index without guards."""
+        with self._lock:
+            out = {k: 0 for k in _KEYS}
+            out.update(self._counts)
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = {k: 0 for k in _KEYS}
+
+
+#: process-wide singleton
+counters = ReliabilityCounters()

@@ -1,0 +1,196 @@
+"""Unified fault-injection registry: one lever for every robustness test.
+
+The seed repo's only fault hook was an env-var counter wired to a single
+dispatch site (`boosting/gbdt.py` `_FAULT_ENV`) that *mutated*
+``os.environ`` as its state — process-global, leaking across tests and
+racing under threads. This module replaces it with an in-process
+registry of named sites and deterministic schedules, keeping the env
+var purely as an initial-schedule *source*.
+
+A schedule is "skip S dispatches, then fail the next N" — the same
+"S:N" grammar the env hook used, so ``LGBM_TPU_INJECT_FUSED_FAULT=2:1``
+still means "let two fused dispatches through, then kill one".
+
+Sites registered by the library (tests may add their own):
+
+==========================  ==================================================
+site                        raised from
+==========================  ==================================================
+``fused_dispatch``          GBDT.train_many, before the fused multi-tree scan
+``histogram_build``         GBDT tree growth dispatch (histogram + split path)
+``collective_psum``         parallel dispatch boundary before sharded growth
+``serving_device_predict``  serving BucketedPredictor.predict_raw
+``checkpoint_io``           reliability.checkpoint bundle writes
+==========================  ==================================================
+
+All injection is host-side, at dispatch boundaries: raising inside
+jit/shard_map-traced code would either bake into the compiled program or
+never run, so the hooks sit where Python still owns control flow.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+__all__ = [
+    "InjectedFault", "FaultRegistry", "faults", "KNOWN_SITES",
+]
+
+KNOWN_SITES = (
+    "fused_dispatch",
+    "histogram_build",
+    "collective_psum",
+    "serving_device_predict",
+    "checkpoint_io",
+)
+
+
+class InjectedFault(RuntimeError):
+    """Raised by `FaultRegistry.inject` when a schedule fires.
+
+    Subclasses RuntimeError so every pre-existing recovery path
+    (train_many's fused fallback, the serving degradation ladder,
+    bench's block retry) treats an injected fault exactly like a real
+    device error."""
+
+    def __init__(self, site: str):
+        super().__init__(f"injected fault at site '{site}' (test hook)")
+        self.site = site
+
+
+class _Schedule:
+    __slots__ = ("skip", "fail")
+
+    def __init__(self, skip: int, fail: int):
+        self.skip = int(skip)
+        self.fail = int(fail)
+
+
+def parse_schedule(val: str) -> Tuple[int, int]:
+    """Parse the "N" / "S:N" grammar into (skip, fail)."""
+    skip, _, fail = str(val).partition(":")
+    if not fail:
+        skip, fail = "0", skip
+    return int(skip), int(fail)
+
+
+class FaultRegistry:
+    """Thread-safe registry of named injection sites.
+
+    ``schedule(site, skip=S, fail=N)`` arms a site; every ``inject``
+    call then consumes one step: the first S calls pass, the next N
+    raise `InjectedFault`, later calls pass. ``trips(site)`` counts
+    how many faults actually fired (visible to metrics/tests)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._schedules: Dict[str, _Schedule] = {}
+        self._trips: Dict[str, int] = {}
+        self._calls: Dict[str, int] = {}
+        # last env value seeded per (env name, site), so an unchanged
+        # env var does not re-arm a consumed schedule
+        self._env_seen: Dict[Tuple[str, str], str] = {}
+
+    # -- arming ---------------------------------------------------------
+    def schedule(self, site: str, fail: int = 1, skip: int = 0) -> None:
+        with self._lock:
+            if fail <= 0 and skip <= 0:
+                self._schedules.pop(site, None)
+            else:
+                self._schedules[site] = _Schedule(skip, fail)
+
+    def schedule_from_env(self, site: str, env: str) -> None:
+        """Seed `site`'s schedule from environment variable `env`.
+
+        The env var is read-only state: the countdown lives in the
+        registry, and re-seeding only happens when the raw env value
+        changes (so a consumed schedule stays consumed)."""
+        val = os.environ.get(env, "")
+        with self._lock:
+            key = (env, site)
+            if self._env_seen.get(key) == val:
+                return
+            self._env_seen[key] = val
+            if not val:
+                self._schedules.pop(site, None)
+                return
+            skip, fail = parse_schedule(val)
+            self._schedules[site] = _Schedule(skip, fail)
+
+    def clear(self, site: Optional[str] = None) -> None:
+        with self._lock:
+            if site is None:
+                self._schedules.clear()
+                self._trips.clear()
+                self._calls.clear()
+                self._env_seen.clear()
+            else:
+                self._schedules.pop(site, None)
+                self._trips.pop(site, None)
+                self._calls.pop(site, None)
+                for key in [k for k in self._env_seen if k[1] == site]:
+                    del self._env_seen[key]
+
+    # -- firing ---------------------------------------------------------
+    def inject(self, site: str) -> None:
+        """Consume one schedule step at `site`; raise when it fires."""
+        with self._lock:
+            self._calls[site] = self._calls.get(site, 0) + 1
+            sched = self._schedules.get(site)
+            if sched is None:
+                return
+            if sched.skip > 0:
+                sched.skip -= 1
+                return
+            if sched.fail > 0:
+                sched.fail -= 1
+                if sched.fail == 0 and sched.skip == 0:
+                    del self._schedules[site]
+                self._trips[site] = self._trips.get(site, 0) + 1
+            else:
+                del self._schedules[site]
+                return
+        raise InjectedFault(site)
+
+    # -- observation ----------------------------------------------------
+    def remaining(self, site: str) -> Tuple[int, int]:
+        """(skip, fail) still pending at `site`; (0, 0) when disarmed."""
+        with self._lock:
+            sched = self._schedules.get(site)
+            return (sched.skip, sched.fail) if sched else (0, 0)
+
+    def trips(self, site: Optional[str] = None) -> int:
+        with self._lock:
+            if site is not None:
+                return self._trips.get(site, 0)
+            return sum(self._trips.values())
+
+    def calls(self, site: str) -> int:
+        with self._lock:
+            return self._calls.get(site, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._trips)
+
+    # -- test convenience -----------------------------------------------
+    def injected(self, site: str, fail: int = 1, skip: int = 0):
+        """Context manager arming `site` on entry, disarming on exit."""
+        registry = self
+
+        class _Ctx:
+            def __enter__(self):
+                registry.schedule(site, fail=fail, skip=skip)
+                return registry
+
+            def __exit__(self, *exc):
+                registry.schedule(site, fail=0, skip=0)
+                return False
+
+        return _Ctx()
+
+
+#: process-wide singleton; everything in the library injects through it
+faults = FaultRegistry()

@@ -1,0 +1,57 @@
+"""Numeric guard rails: catch non-finite training state before it
+poisons the model.
+
+A single NaN gradient (exploding custom objective, bad init score,
+device memory fault) silently corrupts every later iteration — scores
+are cumulative. With ``guard_nonfinite`` enabled the trainer checks
+gradients/hessians before growth and split gains / scores after, and
+applies a policy:
+
+``warn``            log + sanitize non-finite values to 0 and continue
+``skip_iteration``  drop the iteration's contribution, keep training
+``rollback``        `rollback_one_iter` the offending iteration, keep
+                    training (reference Boosting::RollbackOneIter)
+``raise``           raise `GuardError` immediately
+
+Each activation increments the ``guard_trips`` counter. The checks are
+host syncs (one scalar readback per check point), which is why the
+guard is opt-in and forces the per-iteration training path — the fused
+multi-tree scan has no host control flow to interpose on.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..utils.log import Log
+from .counters import counters
+
+__all__ = ["GuardError", "GUARD_POLICIES", "all_finite", "trip"]
+
+GUARD_POLICIES = ("off", "warn", "skip_iteration", "rollback", "raise")
+
+
+class GuardError(RuntimeError):
+    """Raised by the ``raise`` guard policy on non-finite state."""
+
+
+def all_finite(*arrays) -> bool:
+    """True when every element of every array is finite. One fused
+    reduction per array, a single bool readback total."""
+    ok = True
+    for a in arrays:
+        if a is None:
+            continue
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(a)))
+    return bool(ok)
+
+
+def trip(what: str, policy: str, iteration: int) -> None:
+    """Record a guard activation and apply the terminal part of the
+    policy (logging / raising); the caller implements skip/rollback."""
+    counters.inc("guard_trips")
+    msg = (f"non-finite {what} detected at iteration {iteration} "
+           f"(guard_nonfinite={policy})")
+    if policy == "raise":
+        raise GuardError(msg)
+    Log.warning(msg)

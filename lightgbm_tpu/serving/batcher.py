@@ -29,11 +29,20 @@ import numpy as np
 
 from ..utils.log import Log
 
-__all__ = ["MicroBatcher", "OverloadError"]
+__all__ = ["MicroBatcher", "OverloadError", "BatcherClosed"]
 
 
 class OverloadError(RuntimeError):
     """Request shed by admission control (queue depth exceeded)."""
+
+
+class BatcherClosed(RuntimeError):
+    """A queued request's batcher shut down before dispatching it.
+
+    Distinct from a device failure: the request itself is fine, the
+    queue is just going away. The server catches this and drains the
+    request through the host-predict fallback instead of dropping it
+    (and without degrading the model entry)."""
 
 
 class _Request:
@@ -110,12 +119,18 @@ class MicroBatcher:
             self._paused = False
             self._wake.notify()
         self._worker.join(timeout=timeout)
-        # fail any stragglers instead of hanging their callers
+        # the worker drains the queue on close (the take condition
+        # includes _closed), so leftovers only exist when the join
+        # timed out — a wedged device dispatch. Resolve them with
+        # BatcherClosed so upstream can re-route each request through
+        # the host fallback instead of hanging or dropping its caller.
         with self._lock:
             leftovers, self._queue = self._queue, []
         for req in leftovers:
             if not req.future.done():
-                req.future.set_exception(RuntimeError("batcher closed"))
+                req.future.set_exception(BatcherClosed(
+                    f"batcher '{self.name}' closed before dispatching "
+                    f"this request"))
 
     # ------------------------------------------------------------------
     def _take_batch(self) -> Optional[List[_Request]]:

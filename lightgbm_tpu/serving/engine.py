@@ -91,6 +91,11 @@ class BucketedPredictor:
         compiled = first sighting of (model, bucket)."""
         import jax.numpy as jnp
         from ..learner.predict import predict_binned_forest
+        from ..reliability import faults
+
+        # registered fault site: the serving device-dispatch boundary
+        # (retry + host-fallback handling live in serving/server.py)
+        faults.inject("serving_device_predict")
 
         n = bins.shape[0]
         if n == 0:

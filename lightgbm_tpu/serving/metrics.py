@@ -38,6 +38,8 @@ class ModelMetrics:
         self.shed_count = 0
         self.fallback_count = 0  # requests served by the host path
         self.errors = 0
+        self.device_retries = 0  # device dispatches that needed a retry
+        self.guard_trips = 0     # non-finite device outputs caught
         self._started = time.monotonic()
         self._first_request: Optional[float] = None
         self._last_request: Optional[float] = None
@@ -73,6 +75,14 @@ class ModelMetrics:
         with self._lock:
             self.errors += 1
 
+    def record_retry(self) -> None:
+        with self._lock:
+            self.device_retries += 1
+
+    def record_guard_trip(self) -> None:
+        with self._lock:
+            self.guard_trips += 1
+
     # ------------------------------------------------------------------
     def snapshot(self) -> Dict:
         with self._lock:
@@ -93,6 +103,12 @@ class ModelMetrics:
                 "compile_count": self.compile_count,
                 "shed_count": self.shed_count,
                 "fallback_count": self.fallback_count,
+                # degradation visibility (docs/Reliability.md):
+                # "fallbacks" mirrors fallback_count under the unified
+                # reliability-counter name
+                "fallbacks": self.fallback_count,
+                "device_retries": self.device_retries,
+                "guard_trips": self.guard_trips,
                 "errors": self.errors,
                 "uptime_sec": round(time.monotonic() - self._started, 3),
             }

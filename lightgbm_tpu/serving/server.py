@@ -30,9 +30,10 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..reliability import counters, retry_call
 from ..utils.log import Log, LightGBMError
 from ..utils.timer import global_timer
-from .batcher import MicroBatcher, OverloadError
+from .batcher import BatcherClosed, MicroBatcher, OverloadError
 from .engine import BucketedPredictor, max_compilations
 from .metrics import timer_totals
 from .registry import ModelEntry, ModelRegistry
@@ -46,26 +47,34 @@ class Server:
     def __init__(self, *, max_batch_size: int = 1024,
                  max_wait_ms: float = 2.0, max_queue: int = 128,
                  min_bucket: int = 16, max_bucket: int = 1024,
-                 max_models: int = 8):
+                 max_models: int = 8, retry_attempts: int = 3,
+                 retry_backoff_ms: float = 50.0,
+                 retry_backoff_max_ms: float = 2000.0):
         self.registry = ModelRegistry(max_models=max_models)
         self.engine = BucketedPredictor(min_bucket=min_bucket,
                                         max_bucket=max_bucket)
         self.max_batch_size = int(max_batch_size)
         self.max_wait_ms = float(max_wait_ms)
         self.max_queue = int(max_queue)
+        self.retry_attempts = max(1, int(retry_attempts))
+        self.retry_backoff_ms = float(retry_backoff_ms)
+        self.retry_backoff_max_ms = float(retry_backoff_max_ms)
         self._batchers: Dict[str, MicroBatcher] = {}
         self._lock = threading.Lock()
         self._closed = False
 
     @classmethod
     def from_config(cls, config) -> "Server":
-        """Build from a Config carrying the serve_* parameters."""
+        """Build from a Config carrying the serve_*/retry_* parameters."""
         return cls(max_batch_size=config.serve_max_batch_size,
                    max_wait_ms=config.serve_max_wait_ms,
                    max_queue=config.serve_max_queue,
                    min_bucket=config.serve_min_bucket,
                    max_bucket=config.serve_max_bucket,
-                   max_models=config.serve_max_models)
+                   max_models=config.serve_max_models,
+                   retry_attempts=config.retry_max_attempts,
+                   retry_backoff_ms=config.retry_backoff_ms,
+                   retry_backoff_max_ms=config.retry_backoff_max_ms)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -152,6 +161,15 @@ class Server:
         def _finish(fut: Future) -> None:
             try:
                 raw = fut.result()
+            except BatcherClosed:
+                # graceful shutdown drain: the queue is going away, the
+                # model is fine — serve this request on the host path
+                # without degrading the entry
+                Log.info(
+                    f"serving model '{name}': draining request through "
+                    f"host predict on batcher shutdown")
+                self._host_resolve(entry, X, raw_score, t0, out)
+                return
             except Exception as exc:
                 # device failure: degrade this entry to the host path
                 entry.degraded = True
@@ -159,6 +177,19 @@ class Server:
                 Log.warning(
                     f"serving model '{name}': device predict failed "
                     f"({exc}); falling back to host predict")
+                self._host_resolve(entry, X, raw_score, t0, out)
+                return
+            if not np.all(np.isfinite(raw)):
+                # numeric guard rail: non-finite device scores never
+                # reach a caller — recompute on the host and degrade
+                # the entry (a deterministic forest would reproduce
+                # the bad output on every later dispatch)
+                entry.degraded = True
+                entry.metrics.record_guard_trip()
+                counters.inc("guard_trips")
+                Log.warning(
+                    f"serving model '{name}': non-finite device scores; "
+                    f"falling back to host predict")
                 self._host_resolve(entry, X, raw_score, t0, out)
                 return
             try:
@@ -183,13 +214,23 @@ class Server:
             return
         entry.metrics.record_request(len(X), time.perf_counter() - t0,
                                      fallback=True)
+        counters.inc("fallbacks")
         out.set_result(res)
 
     def _make_runner(self, name: str):
         def run(bins: np.ndarray) -> np.ndarray:
             entry = self.registry.get(name)
-            return self.engine.predict_raw(entry.forest, bins,
-                                           metrics=entry.metrics)
+            # transient device faults get capped-exponential-backoff
+            # retries before the degradation ladder (host fallback)
+            # takes over; each retry is visible in the model's metrics
+            return retry_call(
+                self.engine.predict_raw, entry.forest, bins,
+                metrics=entry.metrics,
+                attempts=self.retry_attempts,
+                backoff_ms=self.retry_backoff_ms,
+                backoff_max_ms=self.retry_backoff_max_ms,
+                site=f"serving_device_predict[{name}]",
+                on_retry=entry.metrics.record_retry)
         return run
 
     # test/ops hook: the model's queue (pause/resume/queue_depth)

@@ -1,0 +1,267 @@
+"""Checkpoint/resume: a killed run must resume to a model byte-identical
+to an uninterrupted one (the ISSUE acceptance bar).
+
+The "kill" is simulated by training run A to its checkpoint and then
+throwing the process state away: run B starts from a fresh Dataset and
+a fresh Booster and learns only through `resume_from`. Byte identity of
+`model_to_string()` is the strongest possible equivalence — it covers
+tree structure, leaf values, split gains, and the recorded params.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import callback as cb
+from lightgbm_tpu.reliability import counters
+from lightgbm_tpu.reliability.checkpoint import (latest_checkpoint,
+                                                 load_checkpoint,
+                                                 save_checkpoint)
+from conftest import make_binary
+
+PARAMS = {"objective": "binary", "num_leaves": 7, "learning_rate": 0.2,
+          "max_bin": 63, "verbosity": -1, "min_data_in_leaf": 5, "seed": 3}
+
+
+def _data(seed=1):
+    return make_binary(n=500, f=8, seed=seed)
+
+
+def _ds(X, y):
+    return lgb.Dataset(X.copy(), label=y.copy(), params={"max_bin": 63})
+
+
+@pytest.fixture(autouse=True)
+def _reset_counters():
+    counters.reset()
+    yield
+    counters.reset()
+
+
+# ----------------------------------------------------------------------
+# the acceptance bar: kill-and-resume byte identity
+RESUME_CASES = {
+    "plain": ({}, 4, 8),
+    # checkpoint at iter 4 lands mid bagging period (freq 3): the
+    # cached bag mask must survive the resume
+    "bagging_mid_period": ({"bagging_fraction": 0.8, "bagging_freq": 3,
+                            "bagging_seed": 7}, 4, 9),
+    # GOSS threads a stateful RNG key through every iteration
+    "goss": ({"boosting": "goss", "top_rate": 0.3, "other_rate": 0.2},
+             3, 8),
+    "feature_fraction": ({"feature_fraction": 0.7,
+                          "feature_fraction_seed": 5}, 4, 10),
+    "multiclass": ({"objective": "multiclass", "num_class": 3}, 3, 7),
+}
+
+
+class TestResumeByteIdentity:
+    @pytest.mark.parametrize("case", sorted(RESUME_CASES))
+    def test_resume_matches_uninterrupted(self, case, tmp_path):
+        extra, k, total = RESUME_CASES[case]
+        X, y = _data()
+        if extra.get("objective") == "multiclass":
+            y = (np.abs(X[:, 0]) * 3 % 3).astype(np.int32).astype(
+                np.float32)
+        params = dict(PARAMS)
+        params.update(extra)
+
+        ref = lgb.train(dict(params), _ds(X, y), num_boost_round=total)
+        ref_text = ref.model_to_string()
+
+        # run A: train to k, checkpoint, "die"
+        d = str(tmp_path / "ckpts")
+        lgb.train(dict(params), _ds(X, y), num_boost_round=k,
+                  callbacks=[cb.checkpoint(k, d)])
+        # run B: fresh Dataset + Booster, resume
+        found = latest_checkpoint(d)
+        assert found is not None and found.endswith(f"ckpt_{k:07d}")
+        resumed = lgb.train(dict(params), _ds(X, y),
+                            num_boost_round=total, resume_from=found)
+        assert resumed.model_to_string() == ref_text
+        assert resumed.current_iteration() == total
+
+    def test_resume_predictions_match(self, tmp_path):
+        X, y = _data(seed=9)
+        ref = lgb.train(dict(PARAMS), _ds(X, y), num_boost_round=8)
+        d = str(tmp_path / "c")
+        lgb.train(dict(PARAMS), _ds(X, y), num_boost_round=4,
+                  callbacks=[cb.checkpoint(4, d)])
+        resumed = lgb.train(dict(PARAMS), _ds(X, y), num_boost_round=8,
+                            resume_from=d)
+        np.testing.assert_array_equal(resumed.predict(X), ref.predict(X))
+
+    def test_resume_with_valid_sets_and_eval_history(self, tmp_path):
+        X, y = _data(seed=4)
+        Xv, yv = _data(seed=5)
+        p = dict(PARAMS, metric="binary_logloss")
+        ref = lgb.train(p, _ds(X, y), num_boost_round=8,
+                        valid_sets=[_ds(Xv, yv)], valid_names=["v"])
+        d = str(tmp_path / "c")
+        lgb.train(p, _ds(X, y), num_boost_round=4,
+                  valid_sets=[_ds(Xv, yv)], valid_names=["v"],
+                  callbacks=[cb.checkpoint(4, d)])
+        resumed = lgb.train(p, _ds(X, y), num_boost_round=8,
+                            valid_sets=[_ds(Xv, yv)], valid_names=["v"],
+                            resume_from=d)
+        assert resumed.model_to_string() == ref.model_to_string()
+
+    def test_resume_conflicts_with_init_model(self, tmp_path):
+        X, y = _data()
+        d = str(tmp_path / "c")
+        bst = lgb.train(dict(PARAMS), _ds(X, y), num_boost_round=2,
+                        callbacks=[cb.checkpoint(2, d)])
+        bst.save_model(str(tmp_path / "m.txt"))
+        with pytest.raises(ValueError):
+            lgb.train(dict(PARAMS), _ds(X, y), num_boost_round=4,
+                      resume_from=d,
+                      init_model=str(tmp_path / "m.txt"))
+
+
+# ----------------------------------------------------------------------
+# bundle mechanics
+class TestBundleMechanics:
+    def test_atomic_bundle_layout(self, tmp_path):
+        X, y = _data()
+        d = str(tmp_path / "c")
+        lgb.train(dict(PARAMS), _ds(X, y), num_boost_round=3,
+                  callbacks=[cb.checkpoint(3, d)])
+        bundle = os.path.join(d, "ckpt_0000003")
+        assert sorted(os.listdir(bundle)) == ["arrays.npz", "model.txt",
+                                              "state.json"]
+        state = json.loads(
+            open(os.path.join(bundle, "state.json")).read())
+        assert state["iteration"] == 3
+        assert state["format_version"] == 1
+        # no tmp turds left behind
+        assert not [p for p in os.listdir(d) if p.startswith(".tmp-")]
+        assert open(os.path.join(d, "LATEST")).read().strip() == \
+            "ckpt_0000003"
+
+    def test_keep_last_prunes(self, tmp_path):
+        X, y = _data()
+        d = str(tmp_path / "c")
+        lgb.train(dict(PARAMS), _ds(X, y), num_boost_round=8,
+                  callbacks=[cb.checkpoint(2, d, keep_last=2)])
+        bundles = sorted(p for p in os.listdir(d) if p.startswith("ckpt_"))
+        assert bundles == ["ckpt_0000006", "ckpt_0000008"]
+        assert counters.get("checkpoint_saves") == 4
+
+    def test_period_not_dividing_total_still_saves_final(self, tmp_path):
+        X, y = _data()
+        d = str(tmp_path / "c")
+        lgb.train(dict(PARAMS), _ds(X, y), num_boost_round=5,
+                  callbacks=[cb.checkpoint(3, d)])
+        bundles = sorted(p for p in os.listdir(d) if p.startswith("ckpt_"))
+        assert bundles == ["ckpt_0000003", "ckpt_0000005"]
+
+    def test_latest_checkpoint_scan_fallback(self, tmp_path):
+        X, y = _data()
+        d = str(tmp_path / "c")
+        lgb.train(dict(PARAMS), _ds(X, y), num_boost_round=4,
+                  callbacks=[cb.checkpoint(2, d)])
+        os.remove(os.path.join(d, "LATEST"))  # advisory only
+        found = latest_checkpoint(d)
+        assert found is not None and found.endswith("ckpt_0000004")
+
+    def test_latest_checkpoint_empty_dir(self, tmp_path):
+        assert latest_checkpoint(str(tmp_path)) is None
+        assert latest_checkpoint(str(tmp_path / "missing")) is None
+
+    def test_load_checkpoint_roundtrip(self, tmp_path):
+        d = str(tmp_path / "c")
+        save_checkpoint(d, 7, "model text", {"foo": 1},
+                        {"a": np.arange(3, dtype=np.float32)})
+        ck = load_checkpoint(d)  # parent dir resolves to latest bundle
+        assert ck.iteration == 7
+        assert ck.model_str == "model text"
+        assert ck.state["foo"] == 1
+        np.testing.assert_array_equal(ck.arrays["a"],
+                                      np.arange(3, dtype=np.float32))
+
+    def test_checkpoint_params_validated(self):
+        with pytest.raises(ValueError):
+            cb.checkpoint(0, "/tmp/x")
+        with pytest.raises(ValueError):
+            cb.checkpoint(2, "")
+
+    def test_restore_rejects_mismatched_config(self, tmp_path):
+        X, y = _data()
+        d = str(tmp_path / "c")
+        lgb.train(dict(PARAMS), _ds(X, y), num_boost_round=2,
+                  callbacks=[cb.checkpoint(2, d)])
+        p = dict(PARAMS, objective="multiclass", num_class=3)
+        y3 = (np.abs(X[:, 0]) * 3 % 3).astype(np.float32)
+        with pytest.raises(Exception):
+            lgb.train(p, _ds(X, y3), num_boost_round=4, resume_from=d)
+
+
+# ----------------------------------------------------------------------
+# config + engine wiring
+class TestConfigWiring:
+    def test_checkpoint_period_requires_dir(self):
+        X, y = _data()
+        # period without dir: warned down to disabled, training fine
+        bst = lgb.train(dict(PARAMS, checkpoint_period=2), _ds(X, y),
+                        num_boost_round=2)
+        assert bst.current_iteration() == 2
+
+    def test_params_auto_attach_checkpoint_callback(self, tmp_path):
+        X, y = _data()
+        d = str(tmp_path / "c")
+        lgb.train(dict(PARAMS, checkpoint_period=2, checkpoint_dir=d),
+                  _ds(X, y), num_boost_round=4)
+        bundles = sorted(p for p in os.listdir(d) if p.startswith("ckpt_"))
+        assert bundles == ["ckpt_0000002", "ckpt_0000004"]
+
+
+# ----------------------------------------------------------------------
+# CLI auto-resume (task=train picks up the newest bundle)
+class TestCliAutoResume:
+    def _conf(self, tmp_path, num_trees, ckpt_dir):
+        X, y = make_binary(n=600, f=6, seed=11)
+        data = np.column_stack([y, X])
+        np.savetxt(tmp_path / "train.tsv", data, delimiter="\t")
+        (tmp_path / "train.conf").write_text(f"""
+task = train
+objective = binary
+data = {tmp_path}/train.tsv
+num_trees = {num_trees}
+num_leaves = 7
+learning_rate = 0.2
+max_bin = 63
+output_model = {tmp_path}/model.txt
+checkpoint_period = 3
+checkpoint_dir = {ckpt_dir}
+verbosity = -1
+seed = 3
+""")
+        return tmp_path / "train.conf"
+
+    def test_auto_resume_from_latest(self, tmp_path):
+        from lightgbm_tpu.cli import main
+        ref_dir = tmp_path / "ref"
+        ref_dir.mkdir()
+        conf = self._conf(ref_dir, 9, ref_dir / "nockpt")
+        main([f"config={conf}"])
+        ref_text = (ref_dir / "model.txt").read_text()
+
+        run_dir = tmp_path / "run"
+        run_dir.mkdir()
+        ckpt_dir = run_dir / "ckpts"
+        # first invocation "dies" after 6 trees (two checkpoints)
+        conf = self._conf(run_dir, 6, ckpt_dir)
+        main([f"config={conf}"])
+        assert latest_checkpoint(str(ckpt_dir)).endswith("ckpt_0000006")
+        # re-launch asking for 9: auto-resumes from iteration 6
+        conf = self._conf(run_dir, 9, ckpt_dir)
+        main([f"config={conf}"])
+        run_text = (run_dir / "model.txt").read_text()
+        # recorded path params (data/output_model/checkpoint_dir/config)
+        # legitimately differ between the two runs; the learned model —
+        # everything after the params block — must be byte-identical
+        assert run_text.split("end of parameters")[1] == \
+            ref_text.split("end of parameters")[1]

@@ -1,0 +1,378 @@
+"""Reliability subsystem: fault registry, retry, guards, drain paths.
+
+Every registered fault site gets an injection test that completes
+correctly with the event visible in the reliability counters (the
+ISSUE acceptance bar). The mxu fused path itself cannot compile on
+this jax build (see test_bench_robustness at seed), so fused_dispatch
+is exercised at the registry/shim boundary that train_many calls.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import callback as cb
+from lightgbm_tpu.reliability import (InjectedFault, counters, faults,
+                                      guards, retry_call)
+from lightgbm_tpu.reliability.faults import parse_schedule
+from conftest import make_binary
+
+PARAMS = {"objective": "binary", "num_leaves": 7, "learning_rate": 0.2,
+          "max_bin": 31, "verbosity": -1, "min_data_in_leaf": 5}
+
+
+def _ds(n=300, f=5, seed=2):
+    X, y = make_binary(n=n, f=f, seed=seed)
+    return X, y, lgb.Dataset(X, label=y, params={"max_bin": 31})
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    faults.clear()
+    counters.reset()
+    yield
+    faults.clear()
+    counters.reset()
+    os.environ.pop("LGBM_TPU_INJECT_FUSED_FAULT", None)
+
+
+# ----------------------------------------------------------------------
+# registry semantics
+class TestFaultRegistry:
+    def test_parse_schedule(self):
+        assert parse_schedule("2") == (0, 2)
+        assert parse_schedule("3:1") == (3, 1)
+        assert parse_schedule("0") == (0, 0)
+        with pytest.raises(ValueError):
+            parse_schedule("nope")
+
+    def test_skip_then_fail(self):
+        faults.schedule("histogram_build", fail=2, skip=1)
+        faults.inject("histogram_build")  # skipped
+        with pytest.raises(InjectedFault):
+            faults.inject("histogram_build")
+        with pytest.raises(InjectedFault):
+            faults.inject("histogram_build")
+        faults.inject("histogram_build")  # schedule exhausted
+        assert faults.trips("histogram_build") == 2
+        assert faults.calls("histogram_build") == 4
+        assert faults.remaining("histogram_build") == (0, 0)
+
+    def test_injected_context_manager(self):
+        with faults.injected("collective_psum", fail=1):
+            with pytest.raises(InjectedFault):
+                faults.inject("collective_psum")
+        # cleared on exit even when unconsumed
+        with faults.injected("collective_psum", fail=5):
+            pass
+        faults.inject("collective_psum")
+
+    def test_env_seeding_never_mutates_environ(self):
+        os.environ["LGBM_TPU_INJECT_FUSED_FAULT"] = "1:1"
+        site = "fused_dispatch"
+        faults.schedule_from_env(site, "LGBM_TPU_INJECT_FUSED_FAULT")
+        faults.inject(site)  # skip
+        with pytest.raises(InjectedFault):
+            faults.inject(site)
+        # re-reading the same env value must NOT re-seed the schedule
+        faults.schedule_from_env(site, "LGBM_TPU_INJECT_FUSED_FAULT")
+        faults.inject(site)
+        assert os.environ["LGBM_TPU_INJECT_FUSED_FAULT"] == "1:1"
+        # a *changed* value re-seeds
+        os.environ["LGBM_TPU_INJECT_FUSED_FAULT"] = "1"
+        faults.schedule_from_env(site, "LGBM_TPU_INJECT_FUSED_FAULT")
+        with pytest.raises(InjectedFault):
+            faults.inject(site)
+
+    def test_snapshot_counts_trips(self):
+        faults.schedule("serving_device_predict", fail=1)
+        with pytest.raises(InjectedFault):
+            faults.inject("serving_device_predict")
+        assert faults.snapshot() == {"serving_device_predict": 1}
+
+
+# ----------------------------------------------------------------------
+# retry helper
+class TestRetry:
+    def test_recovers_and_counts(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RuntimeError("transient")
+            return "ok"
+
+        delays = []
+        assert retry_call(flaky, attempts=3, backoff_ms=10.0,
+                          sleep=delays.append) == "ok"
+        assert counters.get("device_retries") == 2
+        assert delays == [0.01, 0.02]
+
+    def test_backoff_capped(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 5:
+                raise RuntimeError("x")
+            return 1
+
+        delays = []
+        retry_call(flaky, attempts=5, backoff_ms=100.0,
+                   backoff_max_ms=150.0, sleep=delays.append)
+        assert delays == [0.1, 0.15, 0.15, 0.15]
+
+    def test_exhaustion_propagates(self):
+        def dead():
+            raise ValueError("permanent")
+
+        with pytest.raises(ValueError):
+            retry_call(dead, attempts=2, backoff_ms=0.0, sleep=lambda s: None)
+        assert counters.get("device_retries") == 1
+
+    def test_on_retry_callback(self):
+        seen = []
+
+        def flaky():
+            if not seen:
+                seen.append(1)
+                raise RuntimeError("once")
+            return True
+
+        assert retry_call(flaky, attempts=2, backoff_ms=0.0,
+                          on_retry=lambda: seen.append("cb"),
+                          sleep=lambda s: None)
+        assert "cb" in seen
+
+
+# ----------------------------------------------------------------------
+# per-site injection (the acceptance bar: each site completes correctly
+# with the event visible in counters)
+@pytest.mark.faults
+class TestFaultSites:
+    def test_histogram_build_retry_recovers(self):
+        X, y, ds = _ds()
+        faults.schedule("histogram_build", fail=1)
+        bst = lgb.train(dict(PARAMS), ds, num_boost_round=3)
+        assert bst.current_iteration() == 3
+        assert faults.trips("histogram_build") == 1
+        assert counters.get("device_retries") == 1
+        # the retried model is identical to an unfaulted one
+        ref = lgb.train(dict(PARAMS), _ds()[2], num_boost_round=3)
+        assert bst.model_to_string() == ref.model_to_string()
+
+    def test_histogram_build_exhaustion_raises(self):
+        X, y, ds = _ds()
+        faults.schedule("histogram_build", fail=10)
+        p = dict(PARAMS, retry_max_attempts=2)
+        with pytest.raises(InjectedFault):
+            lgb.train(p, ds, num_boost_round=2)
+        assert counters.get("device_retries") >= 1
+
+    def test_collective_psum_site(self):
+        from lightgbm_tpu.parallel.comm import check_collective_fault
+        check_collective_fault()  # no schedule -> no-op
+        faults.schedule("collective_psum", fail=1)
+        with pytest.raises(InjectedFault):
+            check_collective_fault()
+        assert faults.trips("collective_psum") == 1
+        check_collective_fault()  # consumed
+
+    def test_collective_psum_end_to_end(self):
+        try:
+            from jax import shard_map  # noqa: F401
+        except ImportError:
+            pytest.skip("jax.shard_map unavailable on this jax build")
+        X, y, ds = _ds(n=400)
+        faults.schedule("collective_psum", fail=1)
+        bst = lgb.train(dict(PARAMS, tree_learner="data", num_devices=4),
+                        ds, num_boost_round=3)
+        assert bst.current_iteration() == 3
+        assert faults.trips("collective_psum") == 1
+        assert counters.get("device_retries") == 1
+
+    def test_checkpoint_io_failure_does_not_kill_training(self, tmp_path):
+        X, y, ds = _ds()
+        faults.schedule("checkpoint_io", fail=1)
+        bst = lgb.train(dict(PARAMS), ds, num_boost_round=4,
+                        callbacks=[cb.checkpoint(2, str(tmp_path))])
+        assert bst.current_iteration() == 4
+        assert counters.get("checkpoint_failures") == 1
+        assert counters.get("checkpoint_saves") == 1  # iteration-4 save
+        bundles = [p for p in os.listdir(tmp_path) if p.startswith("ckpt_")]
+        assert bundles == ["ckpt_0000004"]
+
+    def test_serving_device_predict_retry_recovers(self):
+        X, y, ds = _ds()
+        bst = lgb.train(dict(PARAMS), ds, num_boost_round=3)
+        from lightgbm_tpu.serving import Server
+        with Server(max_wait_ms=0.5, retry_attempts=3,
+                    retry_backoff_ms=1.0) as srv:
+            srv.load_model("m", booster=bst)
+            faults.schedule("serving_device_predict", fail=1)
+            out = srv.predict("m", X[:8])
+            snap = srv.metrics_snapshot("m")["models"]["m"]
+        np.testing.assert_allclose(out, bst.predict(X[:8]), rtol=1e-5)
+        assert snap["device_retries"] == 1
+        assert snap["fallbacks"] == 0
+        assert not snap["degraded"]
+
+    def test_serving_device_predict_exhaustion_falls_back(self):
+        X, y, ds = _ds()
+        bst = lgb.train(dict(PARAMS), ds, num_boost_round=3)
+        from lightgbm_tpu.serving import Server
+        with Server(max_wait_ms=0.5, retry_attempts=2,
+                    retry_backoff_ms=1.0) as srv:
+            srv.load_model("m", booster=bst)
+            faults.schedule("serving_device_predict", fail=10)
+            out = srv.predict("m", X[:8])
+            snap = srv.metrics_snapshot("m")["models"]["m"]
+        np.testing.assert_allclose(out, bst.predict(X[:8]), rtol=1e-6)
+        assert snap["degraded"]
+        assert snap["fallbacks"] == 1
+        assert counters.get("fallbacks") == 1
+
+    def test_fused_dispatch_env_shim(self):
+        # legacy contract: env var seeds the schedule, is never mutated
+        from lightgbm_tpu.boosting.gbdt import _maybe_inject_fused_fault
+        os.environ["LGBM_TPU_INJECT_FUSED_FAULT"] = "1"
+        with pytest.raises(InjectedFault):
+            _maybe_inject_fused_fault()
+        _maybe_inject_fused_fault()  # consumed
+        assert os.environ["LGBM_TPU_INJECT_FUSED_FAULT"] == "1"
+        assert faults.trips("fused_dispatch") == 1
+        assert faults.remaining("fused_dispatch") == (0, 0)
+
+
+# ----------------------------------------------------------------------
+# guard rails
+def _nan_fobj_factory(bad_call):
+    def fobj(preds, dataset):
+        lbl = np.asarray(dataset.get_label())
+        g = np.asarray(preds) - lbl
+        h = np.ones_like(g)
+        fobj.calls += 1
+        if fobj.calls == bad_call:
+            g = g.copy()
+            g[0] = np.nan
+        return g, h
+    fobj.calls = 0
+    return fobj
+
+
+@pytest.mark.faults
+class TestGuards:
+    def test_all_finite(self):
+        import jax.numpy as jnp
+        a = jnp.ones(4)
+        assert guards.all_finite(a, a)
+        assert guards.all_finite(None, a)
+        assert not guards.all_finite(a.at[1].set(jnp.inf))
+
+    @pytest.mark.parametrize("policy", ["warn", "skip_iteration",
+                                        "rollback"])
+    def test_nonfatal_policies_complete(self, policy):
+        X, y, ds = _ds()
+        p = dict(PARAMS, guard_nonfinite=policy)
+        bst = lgb.train(p, ds, num_boost_round=5,
+                        fobj=_nan_fobj_factory(3))
+        assert bst.current_iteration() == 5
+        assert counters.get("guard_trips") == 1
+        assert np.all(np.isfinite(bst.predict(X)))
+
+    def test_raise_policy(self):
+        X, y, ds = _ds()
+        p = dict(PARAMS, guard_nonfinite="raise")
+        with pytest.raises(guards.GuardError):
+            lgb.train(p, ds, num_boost_round=5, fobj=_nan_fobj_factory(3))
+        assert counters.get("guard_trips") == 1
+
+    def test_clean_run_never_trips(self):
+        X, y, ds = _ds()
+        p = dict(PARAMS, guard_nonfinite="warn")
+        bst = lgb.train(p, ds, num_boost_round=5)
+        assert counters.get("guard_trips") == 0
+        # guard must be a pure observer on a healthy run: identical trees
+        ref = lgb.train(dict(PARAMS), _ds()[2], num_boost_round=5)
+        tree_part = bst.model_to_string().split("end of parameters")[1]
+        ref_part = ref.model_to_string().split("end of parameters")[1]
+        assert tree_part == ref_part
+
+    def test_invalid_policy_rejected(self):
+        X, y, ds = _ds()
+        with pytest.raises(Exception):
+            lgb.train(dict(PARAMS, guard_nonfinite="explode"), ds,
+                      num_boost_round=1)
+
+
+# ----------------------------------------------------------------------
+# batcher shutdown drain (satellite 2)
+class TestBatcherDrain:
+    def test_close_drains_queue_through_worker(self):
+        X, y, ds = _ds()
+        bst = lgb.train(dict(PARAMS), ds, num_boost_round=3)
+        from lightgbm_tpu.serving import Server
+        srv = Server(max_wait_ms=200.0)
+        srv.load_model("m", booster=bst)
+        b = srv.batcher("m")
+        b.pause()
+        futs = [srv.predict_async("m", X[i:i + 4]) for i in range(0, 12, 4)]
+        assert b.queue_depth() == 3
+        b.resume()
+        srv.close()  # worker drains the queue before exiting
+        res = np.concatenate([f.result(timeout=10) for f in futs])
+        np.testing.assert_allclose(res, bst.predict(X[:12]), rtol=1e-5)
+
+    def test_wedged_close_resolves_via_host_fallback(self):
+        from lightgbm_tpu.serving.batcher import BatcherClosed
+        X, y, ds = _ds()
+        bst = lgb.train(dict(PARAMS), ds, num_boost_round=3)
+        from lightgbm_tpu.serving import Server
+        srv = Server(max_wait_ms=500.0)
+        srv.load_model("m", booster=bst)
+        b = srv.batcher("m")
+        b.pause()
+        futs = [srv.predict_async("m", X[i:i + 4]) for i in range(0, 12, 4)]
+        # simulate a wedged worker: close() cannot join, leftovers get
+        # BatcherClosed and the server re-routes them to host predict
+        b._worker.join = lambda timeout=None: None
+        b.close()
+        res = np.concatenate([f.result(timeout=10) for f in futs])
+        snap = srv.metrics_snapshot("m")["models"]["m"]
+        np.testing.assert_allclose(res, bst.predict(X[:12]), rtol=1e-6)
+        assert not snap["degraded"]          # model itself is healthy
+        assert snap["fallbacks"] == 3
+        assert snap["errors"] == 0
+        with pytest.raises(RuntimeError):
+            b.submit(np.zeros((1, 5), np.int32))
+
+    def test_metrics_snapshot_schema(self):
+        X, y, ds = _ds()
+        bst = lgb.train(dict(PARAMS), ds, num_boost_round=2)
+        from lightgbm_tpu.serving import Server
+        with Server() as srv:
+            srv.load_model("m", booster=bst)
+            srv.predict("m", X[:4])
+            snap = srv.metrics_snapshot("m")["models"]["m"]
+        for key in ("device_retries", "fallbacks", "guard_trips"):
+            assert key in snap, key
+
+
+# ----------------------------------------------------------------------
+# process-wide counters
+class TestCounters:
+    def test_snapshot_schema_complete(self):
+        snap = counters.snapshot()
+        for key in ("device_retries", "fallbacks", "guard_trips",
+                    "checkpoint_saves", "checkpoint_failures"):
+            assert key in snap and snap[key] == 0
+
+    def test_inc_and_reset(self):
+        counters.inc("guard_trips")
+        counters.inc("guard_trips", 2)
+        assert counters.get("guard_trips") == 3
+        counters.reset()
+        assert counters.get("guard_trips") == 0
