@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-all faults bench install
+.PHONY: test test-slow test-all faults observe bench install
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -16,6 +16,11 @@ test:
 # recovered (tests/test_reliability.py, docs/Reliability.md)
 faults:
 	$(PY) -m pytest tests/ -x -q -m faults
+
+# the observability tier: spans, training telemetry, MFU accounting,
+# Prometheus /metrics (tests/test_observability.py, docs/Observability.md)
+observe:
+	$(PY) -m pytest tests/test_observability.py -x -q
 
 # batched: the whole slow tier in ONE pytest process hard-crashed the
 # interpreter twice (not OOM; see TESTS.md round 4) — per-batch runs

@@ -253,6 +253,49 @@ def _serve_bench(bench, result):
               file=sys.stderr)
 
 
+def _task_bench(result):
+    """Task-matrix rows (VERDICT r4 item 2, promoted into the official
+    record): regression / multiclass / lambdarank through
+    helpers/bench_tasks.py at the bench posture, one dict per task
+    appended to result["tasks"] — {"task", "value" (trees/sec),
+    "unit", "metric", "metric_value", "vs_single_core"}. Keys MERGE
+    into the single JSON record, like _serve_bench. Best-effort: a
+    task fault leaves the rows gathered so far. BENCH_TASKS="" skips
+    (robustness tests; the binary headline is unaffected),
+    BENCH_TASK_TREES scales depth."""
+    spec = os.environ.get("BENCH_TASKS",
+                          "regression,multiclass,lambdarank")
+    names = [t.strip() for t in spec.split(",") if t.strip()]
+    if not names:
+        return
+    n_trees = int(os.environ.get("BENCH_TASK_TREES", 60))
+    try:
+        from helpers.bench_tasks import (SINGLE_CORE_RATES, TASKS,
+                                         run_ours)
+    except Exception as exc:
+        print(f"# task bench unavailable: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
+        return
+    for name in names:
+        if name not in TASKS:
+            print(f"# task bench: unknown task {name!r}; skipped",
+                  file=sys.stderr)
+            continue
+        try:
+            rate, metric_value = run_ours(name, n_trees)
+        except Exception as exc:
+            print(f"# task bench [{name}] failed: "
+                  f"{type(exc).__name__}: {exc}", file=sys.stderr)
+            continue
+        anchor = SINGLE_CORE_RATES.get(name, 0.0)
+        result["tasks"].append({
+            "task": name, "value": round(float(rate), 3),
+            "unit": "trees/sec", "metric": TASKS[name]["metric"],
+            "metric_value": round(float(metric_value), 6),
+            "vs_single_core": round(float(rate) / anchor, 3)
+            if anchor else 0.0})
+
+
 def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     result = {"metric": "higgs1m_trees_per_sec", "value": 0.0,
@@ -267,7 +310,17 @@ def main():
               # reliability-counter schema (overwritten from the live
               # counters at the end of the run)
               "device_retries": 0, "fallbacks": 0, "guard_trips": 0,
-              "checkpoint_saves": 0, "checkpoint_failures": 0}
+              "checkpoint_saves": 0, "checkpoint_failures": 0,
+              # device-utilization schema (observability/mfu.py):
+              # achieved TFLOP/s from the analytic per-tree histogram
+              # MAC count x the measured trees/sec; mfu_per_tree = that
+              # over the device's bf16 peak (0.0 when the peak is
+              # unknown, e.g. CPU or interpret mode)
+              "achieved_tflops": 0.0, "mfu_per_tree": 0.0,
+              "device_peak_tflops": 0.0,
+              # per-task rows (regression/multiclass/lambdarank) from
+              # helpers/bench_tasks.py, filled by _task_bench
+              "tasks": []}
     block_times = []
     block_trees = min(BLOCK_TREES, BENCH_TREES)
     bench = None
@@ -316,7 +369,30 @@ def main():
             median_rate / BASELINE_TREES_PER_SEC, 3)
         result["vs_single_core"] = round(
             median_rate / SINGLE_CORE_TREES_PER_SEC, 3)
+        try:
+            # device utilization: analytic MACs of one tree at the
+            # bench posture (quantized grads -> 3 histogram channels;
+            # binary log-loss has non-constant hessians, so the
+            # const-hessian channel drop never applies) x measured rate
+            from lightgbm_tpu.observability import mfu as _mfu
+            tmacs = _mfu.tree_macs(
+                num_leaves=NUM_LEAVES, num_rows=N_ROWS,
+                num_features=N_FEATURES, bmax=MAX_BIN,
+                quantized=True, const_hess=False,
+                hist_subtraction=True,
+                overshoot=PARAMS["growth_overshoot"],
+                bridge_gate=PARAMS["growth_bridge_gate"])
+            tflops = _mfu.achieved_tflops(tmacs * median_rate)
+            peak = _mfu.device_peak_tflops()
+            result["achieved_tflops"] = round(tflops, 4)
+            result["device_peak_tflops"] = peak
+            if peak:
+                result["mfu_per_tree"] = round(tflops / peak, 6)
+        except Exception as exc:
+            print(f"# device-utilization accounting failed: {exc}",
+                  file=sys.stderr)
     _serve_bench(bench, result)
+    _task_bench(result)
     try:
         # reliability counters (lightgbm_tpu/reliability/): how degraded
         # this record is — retries, fused->per-iter / device->host
@@ -351,6 +427,21 @@ def _report(result, block_times, block_trees, bench):
         print(f"# held-out AUC after "
               f"{bench.booster.current_iteration()} trees: {auc:.5f}",
               file=sys.stderr)
+        if result.get("achieved_tflops"):
+            peak = result.get("device_peak_tflops", 0.0)
+            mfu_s = (f"MFU {result['mfu_per_tree']:.4f} of "
+                     f"{peak:.0f} TFLOP/s bf16 peak") if peak else \
+                "MFU n/a (unknown device peak; set LGBM_TPU_PEAK_TFLOPS)"
+            print(f"# device utilization: "
+                  f"{result['achieved_tflops']:.3f} achieved TFLOP/s "
+                  f"from analytic histogram MACs "
+                  f"(observability/mfu.py, slight lower bound), {mfu_s}",
+                  file=sys.stderr)
+        for row in result.get("tasks", []):
+            print(f"# task {row['task']}: {row['value']:.2f} trees/sec "
+                  f"({row['vs_single_core']:.2f}x single-core ref), "
+                  f"{row['metric']} = {row['metric_value']:.5f}",
+                  file=sys.stderr)
         print("# note: vs_baseline uses the reference's published "
               "10.5M-row 28-core Higgs rate; vs_single_core uses the "
               "same-host single-core reference on THIS synthetic "

@@ -43,6 +43,13 @@ POSTURE = {"num_leaves": 255, "max_bin": 255, "learning_rate": 0.1,
            "use_quantized_grad": True, "growth_overshoot": 1.75,
            "growth_bridge_gate": 0.93}
 
+# same-host single-core reference rates on these exact synthetic sets
+# (run_reference on an idle host, docs/PerfNotes.md round 5) — the
+# per-task anchors bench.py's task rows normalize against, mirroring
+# SINGLE_CORE_TREES_PER_SEC for the binary headline
+SINGLE_CORE_RATES = {"regression": 3.76, "multiclass": 2.93,
+                     "lambdarank": 2.47}
+
 
 # ---------------------------------------------------------------- data
 def make_regression(n, seed):

@@ -18,6 +18,7 @@ try:  # user-facing API (available once all layers are built)
                            record_evaluation, reset_parameter)
     from .engine import cv, train
     from .plotting import plot_importance, plot_metric, plot_tree
+    from . import observability
     from . import serving
 except ImportError:  # pragma: no cover - during partial builds only
     pass
@@ -25,5 +26,5 @@ except ImportError:  # pragma: no cover - during partial builds only
 __all__ = ["Dataset", "Booster", "Sequence", "train", "cv", "Config", "LightGBMError",
            "register_logger", "early_stopping", "log_evaluation",
            "record_evaluation", "reset_parameter", "plot_importance",
-           "plot_metric", "plot_tree", "setup_multihost", "serving",
-           "__version__"]
+           "plot_metric", "plot_tree", "setup_multihost", "observability",
+           "serving", "__version__"]

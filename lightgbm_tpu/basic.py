@@ -530,6 +530,10 @@ class Booster:
         self.params = dict(params or {})
         self.config = param_dict_to_config(self.params)
         Log.set_verbosity(self.config.verbosity)
+        if self.config.observe:
+            from .observability import registry as _obs
+            _obs.enable(ring=self.config.observe_ring,
+                        norms=self.config.observe_norms)
         self._model = None          # HostModel once finalized/loaded
         self.gbdt = None
         self.train_set = None
@@ -592,6 +596,11 @@ class Booster:
         self._model = None
         if fobj is not None:
             import jax.numpy as jnp
+            # user-supplied gradients: the configured objective's
+            # constant-hessian promise no longer holds (engine.train
+            # handles this by resetting objective to "none"; this direct
+            # path must neutralize the fast-path gate itself)
+            self.gbdt.set_custom_objective()
             score = self.gbdt.train_score
             grad, hess = fobj(np.asarray(score), self.train_set)
             return self.gbdt.train_one_iter(

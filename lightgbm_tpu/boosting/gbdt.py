@@ -18,6 +18,7 @@ differences:
 from __future__ import annotations
 
 import os
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -32,6 +33,7 @@ from ..learner.renew import renew_tree_output
 from ..learner.split import SplitHyperParams
 from ..metrics import Metric
 from ..objectives import ObjectiveFunction
+from ..observability import registry as _obs
 from ..reliability import counters, faults, guards, retry_call
 from ..utils.log import Log, LightGBMError
 from ..utils.timer import global_timer
@@ -563,12 +565,34 @@ class GBDT:
         ride the hessian but not cnt_weight — both break the
         h == const x cnt identity, so they gate it off. Bagging keeps
         it (the mask scales hessian AND count identically). Must be
-        evaluated AFTER objective.init() has bound weights."""
+        evaluated AFTER objective.init() has bound weights.
+
+        A custom objective (Booster.update(fobj=...)) supplies
+        arbitrary per-row hessians, so the bound objective's
+        is_constant_hessian promise no longer describes the gradients
+        actually trained on — the reference neutralizes this by
+        resetting objective to "none" in engine.train; the direct
+        update(fobj) path flips `_custom_objective` instead (see
+        set_custom_objective)."""
+        if getattr(self, "_custom_objective", False):
+            return 0.0
         return 1.0 if (
             self.objective is not None and
             getattr(self.objective, "is_constant_hessian", False) and
             getattr(self.objective, "weight", None) is None and
             self.config.boosting != "goss") else 0.0
+
+    def set_custom_objective(self) -> None:
+        """Mark this booster as trained (at least once) on user-supplied
+        gradients. Drops the constant-hessian fast path — the kernels
+        would otherwise reconstruct hessian sums from row counts and
+        silently mis-train on any fobj whose hessian isn't exactly the
+        count weight — and invalidates caches that baked the old gate
+        (the fused scan closure and the analytic MAC estimate)."""
+        if not getattr(self, "_custom_objective", False):
+            self._custom_objective = True
+            self._fused_run = None
+            self._obs_tree_macs = None
 
     def _mxu_grow_kwargs(self):
         """Static grow_tree_mxu settings — single source shared by the
@@ -816,6 +840,14 @@ class GBDT:
         cfg = self.config
         k = self.num_tree_per_iteration
         init_scores = [0.0] * k
+        # observability: off path is this one branch; the guard
+        # skip-iteration early return below goes unrecorded (rare,
+        # and its counters surface in the next record's deltas)
+        _orec = _obs.enabled
+        if _orec:
+            _obs_iter = self.iter_
+            _obs_ph0 = global_timer.totals()
+            _obs_t0 = time.perf_counter()
 
         with global_timer.timeit("boosting"):
             if gradients is None or hessians is None:
@@ -965,6 +997,12 @@ class GBDT:
                         self.tree_class.append(cls)
                         self.linear_models.append(None)
                     self.iter_ += 1
+        if _orec:
+            _obs.record_train_iteration(
+                self, _obs_iter, _obs_t0, time.perf_counter() - _obs_t0,
+                phases=_obs.phase_deltas(_obs_ph0),
+                gradients=gradients, hessians=hessians,
+                tree=self.trees[-1] if self.trees else None)
         return not should_continue
 
     @staticmethod
@@ -1210,6 +1248,11 @@ class GBDT:
                 self._fused_run = None  # closure may hold dead executables
                 raise
 
+        _orec = _obs.enabled
+        if _orec:
+            _obs_iter0 = self.iter_
+            _obs_was_built = getattr(self, "_fused_run", None) is None
+            _obs_t0 = time.perf_counter()
         try:
             # capped-exponential-backoff retries before degrading: a
             # transient launch failure should not cost the fused path
@@ -1240,6 +1283,14 @@ class GBDT:
             _seal()
             return stop
         self._fused_failures = 0
+        if _orec:
+            # the fused scan is lazy: force completion so the recorded
+            # wall covers device work, then record the whole block as
+            # one telemetry record (no host boundary inside it)
+            jax.block_until_ready(score)
+            _obs.record_fused_block(
+                self, _obs_iter0, k, _obs_t0,
+                time.perf_counter() - _obs_t0, _obs_was_built)
         self.train_score = score
         kcls = self.num_tree_per_iteration
         if self.valid_sets:

@@ -224,15 +224,32 @@ class Application:
                 resume_from = found
                 init_model = None
                 Log.info("Auto-resuming from checkpoint %s", found)
-        booster = train_fn(dict(self.params), dtrain,
-                           num_boost_round=cfg.num_iterations,
-                           valid_sets=valid_sets or None,
-                           valid_names=valid_names or None,
-                           callbacks=callbacks,
-                           init_model=init_model,
-                           resume_from=resume_from)
+        msrv = None
+        if cfg.observe and cfg.observe_metrics_port > 0:
+            # live Prometheus scrape surface for the duration of the run
+            from .observability import MetricsHTTPServer
+            from .observability import registry as _obs
+            msrv = MetricsHTTPServer(_obs.prometheus_text, _obs.snapshot,
+                                     port=cfg.observe_metrics_port)
+            Log.info("observability metrics at %s", msrv.url)
+        try:
+            booster = train_fn(dict(self.params), dtrain,
+                               num_boost_round=cfg.num_iterations,
+                               valid_sets=valid_sets or None,
+                               valid_names=valid_names or None,
+                               callbacks=callbacks,
+                               init_model=init_model,
+                               resume_from=resume_from)
+        finally:
+            if msrv is not None:
+                msrv.close()
         booster.save_model(cfg.output_model)
         Log.info("Finished training, model saved to %s", cfg.output_model)
+        if cfg.observe and cfg.observe_trace_file:
+            from .observability import registry as _obs
+            fmt = _obs.dump_trace(cfg.observe_trace_file)
+            Log.info("Wrote %s span trace to %s", fmt,
+                     cfg.observe_trace_file)
 
     def predict(self) -> None:
         cfg = self.config
@@ -294,6 +311,12 @@ class Application:
         from .serving import Server
         X, _ = _load_text_data(cfg.data, cfg)
         with Server.from_config(cfg) as server:
+            if cfg.observe:
+                from .observability import registry as _obs
+                _obs.enable(ring=cfg.observe_ring)
+                msrv = server.start_metrics_server(
+                    port=cfg.observe_metrics_port)
+                Log.info("observability metrics at %s", msrv.url)
             server.load_model("default", model_file=cfg.input_model)
             # mixed-size request stream: walk the file in growing chunks
             # so the bucket cache sees many batch shapes, like live
@@ -321,6 +344,11 @@ class Application:
                  "buckets), results saved to %s, metrics to %s",
                  m["requests"], m["rows"], m["buckets_compiled"],
                  cfg.output_result, metrics_path)
+        if cfg.observe and cfg.observe_trace_file:
+            from .observability import registry as _obs
+            fmt = _obs.dump_trace(cfg.observe_trace_file)
+            Log.info("Wrote %s span trace to %s", fmt,
+                     cfg.observe_trace_file)
 
     def convert_model(self) -> None:
         cfg = self.config

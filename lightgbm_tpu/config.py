@@ -195,6 +195,28 @@ _PARAMS: List[ParamSpec] = [
     _p("serve_max_bucket", int, 1024, ("max_bucket",), lambda v: v > 0),
     _p("serve_max_models", int, 8, (), lambda v: v > 0),
     _p("serve_metrics_file", str, "", ("metrics_file",)),
+    # ---- Observability (lightgbm_tpu/observability/,
+    #      docs/Observability.md) ----
+    _p("observe", bool, False, ("observability",),
+       desc="enable the unified observability registry: per-iteration "
+            "training telemetry, structured spans, compile accounting "
+            "and device-utilization (MFU) accounting. Off by default; "
+            "the disabled path costs one branch per site"),
+    _p("observe_ring", int, 4096, (), lambda v: v >= 16,
+       desc="ring-buffer capacity for buffered spans and per-iteration "
+            "telemetry records (oldest evicted; aggregates unaffected)"),
+    _p("observe_norms", bool, False, (),
+       desc="also record per-iteration gradient/hessian norms and "
+            "leaves grown. These force a host sync per iteration — "
+            "diagnostic posture, not benchmarking. Implies observe"),
+    _p("observe_trace_file", str, "", ("trace_file",),
+       desc="write the span trace here after training: .jsonl for "
+            "JSON-lines, anything else for Chrome/Perfetto trace_event "
+            "JSON (chrome://tracing, ui.perfetto.dev). Implies observe"),
+    _p("observe_metrics_port", int, 0, ("metrics_port",), lambda v: v >= 0,
+       desc="serve Prometheus text-format metrics on this localhost "
+            "port during task=train or task=serve (0 = off; serving "
+            "picks an ephemeral port when 0 and observe is on)"),
     # ---- Reliability (lightgbm_tpu/reliability/, docs/Reliability.md) ----
     _p("checkpoint_period", int, 0, ("checkpoint_freq", "snapshot_period"),
        lambda v: v >= 0),
@@ -433,6 +455,10 @@ class Config:
                 "checkpoint_period > 0 needs checkpoint_dir; "
                 "checkpointing disabled")
             self.checkpoint_period = 0
+        if (self.observe_trace_file or self.observe_norms or
+                self.observe_metrics_port > 0) and not self.observe:
+            # asking for an observability output implies observing
+            self.observe = True
         if self.serve_max_bucket < self.serve_min_bucket:
             from .utils.log import Log
             Log.warning(

@@ -74,6 +74,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
         # the base model's raw predictions BEFORE dataset construction
         # (raw features are still present), and the base trees are merged
         # into the final model so predict/save include them.
+        #
+        # Bounded-divergence caveat: when a PREVIOUS train() call ended
+        # in a mid-block early stop (fused block path below), that
+        # booster's train_score carries the rollback's add-then-subtract
+        # ULP residue — at most one f32 rounding per rolled-back tree.
+        # Continuing from it trains the first new trees against
+        # gradients of those scores, so a continued model can diverge
+        # from a never-stopped reference by that same bounded residue;
+        # seeding here via base-model PREDICTIONS (recomputed, not the
+        # stored train_score) keeps the divergence to the residue itself
+        # rather than compounding it.
         base_model = init_model if isinstance(init_model, Booster) else \
             Booster(model_file=init_model)
 
@@ -257,7 +268,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
                             # ULP-level residue; train_score keeps the
                             # subtractive form — the booster is normally
                             # returned at this point, and the residue is
-                            # bounded by one rounding per rolled tree)
+                            # bounded by one rounding per rolled tree;
+                            # a later train(init_model=this_booster)
+                            # inherits that bounded divergence — see the
+                            # continued-training note above)
                             for vi in range(len(traj)):
                                 gb.valid_scores[vi] = traj[vi][b - 1]
                             for _ in range(b - 1 - j):

@@ -17,11 +17,13 @@ TPU reformulation of CUDADataPartition::SplitInner
 
 Feature parity vs grow_tree: numerical + categorical splits, NaN routing,
 monotone constraints, interaction constraints, feature_fraction_bynode,
-extra_trees. Best-first (leaf-wise) growth order is recovered by
-overgrow-and-prune (`overshoot`, default via growth_overshoot) or
-approximated by the hybrid tail throttle (`tail_split_cap`). Not
-supported here (callers fall back to grow_tree): forced splits, CEGB,
-distributed comm.
+extra_trees, forced splits (forced_splits json), CEGB (eager penalties;
+lazy per-row feature penalties still fall back), and distributed growth
+(the psum'd histogram merge under data/voting-parallel). The remaining
+fallbacks to grow_tree are the ones gbdt._mxu_exclusions enforces:
+max_bin > 256, non-basic monotone_constraints_method, CEGB with
+cegb_penalty_feature_lazy, and EFB configurations the kernel cannot
+route (see that method for the authoritative list).
 """
 
 from __future__ import annotations

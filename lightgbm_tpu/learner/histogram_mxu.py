@@ -47,8 +47,11 @@ __all__ = ["build_histograms_mxu", "build_histograms_mxu_v2",
            "quantize_gradients", "pack_bins_4bit", "unpack_bins_4bit"]
 
 # v5e has 128 MB VMEM; the default 16 MB scoped limit starves the
-# accumulate-in-VMEM histogram output on small row counts
-_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+# accumulate-in-VMEM histogram output on small row counts.
+# jax < 0.5 names the params class TPUCompilerParams
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+_COMPILER_PARAMS = _CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
 
 # features per accumulating dot in the v2/fused kernels: batching widens
 # the MXU output tile (a [nb, C*S] x [nb, G*B] dot instead of G narrow
@@ -1047,10 +1050,15 @@ def route_rows_mxu(bins: jax.Array, row_node: jax.Array, tbl: jax.Array,
     # narrow-input dense routing — wide tables ([nb, m] one-hot), wide
     # bins blocks, and both EFB modes (the expansion decode OOM'd at a
     # 2048 block on 250-column bundles, grower_mxu.py sweep note) keep
-    # the conservative 1024
+    # the conservative 1024. The table cutoff is m <= 1024: the one-hot
+    # route tensor is [nb, m] f32, so nb=4096 at m=2048 is a 32 MiB
+    # operand (4096*2048*4) before the matmul's output — past the
+    # ~16 MiB/core VMEM budget the measured case (m=896, 14 MiB) stays
+    # inside, and exactly the fits_v2-style bound the histogram side
+    # enforces for its own scan tensors.
     if row_block:
         nb = row_block
-    elif m <= 2048 and fcols <= 128 and loc_table is None \
+    elif m <= 1024 and fcols <= 128 and loc_table is None \
             and not efb_range:
         nb = 4096
     else:

@@ -1,0 +1,54 @@
+"""Unified observability: spans, telemetry, MFU, compiles, exporters.
+
+One process-global registry (`registry`) subsumes the fragments that
+grew separately — `utils.timer.global_timer` (phase totals),
+`reliability.counters` (degradation counters), `serving.metrics`
+(per-model request metrics) — and adds what they cannot express:
+
+- structured spans (`registry.trace.span("grow_tree", iter=i)`) with
+  thread-safe nesting, an in-memory ring, and JSONL / Chrome-Perfetto
+  `trace_event` export (`registry.dump_trace(path)`);
+- per-iteration training telemetry (iteration wall time, phase split,
+  grad/hess norms, leaves grown, bagging fraction, reliability-counter
+  deltas) hooked into `boosting/gbdt.py`;
+- device-utilization accounting: achieved MACs from the MXU histogram
+  kernel dimensions (nchan * S * N * F * B, learner/histogram_mxu.py)
+  turned into achieved-TFLOP/s and model-flops-utilization (MFU);
+- compile accounting (compile count/seconds per jitted entry,
+  shape-bucket hits — the serving bucket-cache semantics);
+- exporters: `registry.snapshot()` JSON dict, Prometheus text format
+  (served from `serving/server.py` at /metrics), `dump_trace(path)`.
+
+The registry is disabled by default; every instrumentation site is a
+single `if registry.enabled:` branch, so the off path costs one
+attribute read (<2% of any phase). Enable with the `observe` parameter
+(config.py), `registry.enable()`, or per-surface flags.
+
+Reference analog: Common::Timer / FunctionTimer RAII accumulators
+printed under USE_TIMETAG (include/LightGBM/utils/common.h:973) — here
+the accumulators are structured, exportable, and device-aware.
+"""
+
+from __future__ import annotations
+
+from . import mfu
+from .compiles import CompileAccounting
+from .export import MetricsHTTPServer, prometheus_lines
+from .registry import ObservabilityRegistry, registry
+from .telemetry import TrainingTelemetry
+from .trace import Span, Trace
+
+__all__ = [
+    "registry", "ObservabilityRegistry", "Trace", "Span",
+    "TrainingTelemetry", "CompileAccounting", "MetricsHTTPServer",
+    "prometheus_lines", "mfu", "span", "snapshot", "dump_trace",
+    "prometheus_text", "enable", "disable",
+]
+
+# module-level conveniences bound to the process-global registry
+span = registry.trace.span
+snapshot = registry.snapshot
+dump_trace = registry.dump_trace
+prometheus_text = registry.prometheus_text
+enable = registry.enable
+disable = registry.disable

@@ -1,0 +1,68 @@
+"""Compile accounting: count/seconds per jitted entry + cache hits.
+
+XLA does not expose per-entry compile walls through a stable API, so
+the accounting brackets the FIRST dispatch of an entry (trace + compile
++ first run) and counts later dispatches as hits — the same semantics
+the serving bucket cache already uses (serving/engine.py: a
+(model, bucket) miss IS a compilation of the serving predictor, a hit
+is a cached dispatch). `compile_seconds` therefore includes the first
+execution; for the large jitted entries here (fused multi-tree scan,
+bucketed predictor) compilation dominates that first wall by an order
+of magnitude, and the bound is honest: real compile time never exceeds
+the recorded figure.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict
+
+__all__ = ["CompileAccounting"]
+
+
+class CompileAccounting:
+    """Thread-safe per-entry {compiles, hits, compile_seconds}."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[str, Dict] = {}
+
+    def record(self, entry: str, seconds: float = 0.0,
+               compiled: bool = True) -> None:
+        with self._lock:
+            rec = self._entries.setdefault(
+                entry, {"compiles": 0, "hits": 0, "compile_seconds": 0.0})
+            if compiled:
+                rec["compiles"] += 1
+                rec["compile_seconds"] += float(seconds)
+            else:
+                rec["hits"] += 1
+
+    @contextlib.contextmanager
+    def track(self, entry: str, compiled: bool = True):
+        """Bracket a dispatch; the wall is attributed as compile
+        seconds when `compiled` (first sighting), else counted a hit."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(entry, time.perf_counter() - t0, compiled)
+
+    def snapshot(self) -> Dict[str, Dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._entries.items()}
+
+    def totals(self) -> Dict:
+        snap = self.snapshot()
+        return {
+            "compile_count": sum(v["compiles"] for v in snap.values()),
+            "hit_count": sum(v["hits"] for v in snap.values()),
+            "compile_seconds": round(
+                sum(v["compile_seconds"] for v in snap.values()), 6),
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._entries.clear()

@@ -1,0 +1,198 @@
+"""Structured spans: thread-safe nesting, ring buffer, trace export.
+
+A span is one timed region with attributes. Nesting is tracked with a
+per-thread stack (`threading.local`), so concurrent threads — the
+serving micro-batcher workers, checkpoint writers — interleave freely
+without corrupting each other's parent/depth bookkeeping. Completed
+spans land in one lock-guarded ring (`collections.deque(maxlen=...)`),
+oldest-evicted, so tracing a long training run is O(ring) memory.
+
+Export formats:
+- JSONL: one span dict per line (jq/pandas-friendly);
+- Chrome/Perfetto `trace_event` JSON ("ph": "X" complete events with
+  microsecond ts/dur), loadable in chrome://tracing or ui.perfetto.dev.
+
+The disabled path returns a shared no-op context manager — no
+allocation, no clock read, one attribute check.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["Span", "Trace"]
+
+
+class _NullSpan:
+    """Shared no-op context manager for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """One live timed region; append itself to the ring on __exit__."""
+
+    __slots__ = ("_trace", "name", "attrs", "start", "duration",
+                 "depth", "parent")
+
+    def __init__(self, trace: "Trace", name: str, attrs: Dict):
+        self._trace = trace
+        self.name = name
+        self.attrs = attrs
+        self.start = 0.0
+        self.duration = 0.0
+        self.depth = 0
+        self.parent: Optional[str] = None
+
+    def __enter__(self) -> "Span":
+        stack = self._trace._stack()
+        self.depth = len(stack)
+        self.parent = stack[-1].name if stack else None
+        stack.append(self)
+        self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.duration = time.perf_counter() - self.start
+        stack = self._trace._stack()
+        # balanced exit is the overwhelmingly common case; an exception
+        # unwinding several spans at once still pops each in turn
+        if stack and stack[-1] is self:
+            stack.pop()
+        else:  # pragma: no cover - unbalanced enter/exit
+            try:
+                stack.remove(self)
+            except ValueError:
+                pass
+        self._trace._append(self.name, self.start, self.duration,
+                            self.depth, self.parent, self.attrs)
+        return False
+
+
+class Trace:
+    """Span factory + completed-span ring. Thread-safe."""
+
+    def __init__(self, capacity: int = 4096):
+        self.enabled = False
+        self._lock = threading.Lock()
+        self._ring = collections.deque(maxlen=max(int(capacity), 16))
+        self._local = threading.local()
+        self._epoch = time.perf_counter()
+        self.dropped = 0          # spans evicted from the ring
+
+    # ------------------------------------------------------------------
+    def span(self, name: str, **attrs):
+        """Context manager timing a region; no-op when disabled."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return Span(self, name, attrs)
+
+    def add(self, name: str, start: float, duration: float, **attrs):
+        """Record an already-measured region (hot-path hooks measure
+        with their own perf_counter reads and call this once, keeping
+        the instrumented loop free of context-manager plumbing).
+        `start` is a time.perf_counter() timestamp."""
+        if not self.enabled:
+            return
+        self._append(name, start, duration, 0, None, attrs)
+
+    # ------------------------------------------------------------------
+    def _stack(self) -> List[Span]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def _append(self, name, start, duration, depth, parent, attrs):
+        rec = {
+            "name": name,
+            "ts": start - self._epoch,       # seconds since trace epoch
+            "dur": duration,                 # seconds
+            "tid": threading.get_ident(),
+            "depth": depth,
+        }
+        if parent is not None:
+            rec["parent"] = parent
+        if attrs:
+            rec["attrs"] = attrs
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(rec)
+
+    # ------------------------------------------------------------------
+    def set_capacity(self, capacity: int) -> None:
+        with self._lock:
+            self._ring = collections.deque(self._ring,
+                                           maxlen=max(int(capacity), 16))
+
+    def spans(self) -> List[Dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.dropped = 0
+            self._epoch = time.perf_counter()
+
+    # ------------------------------------------------------------------
+    # export
+    def to_chrome_trace(self) -> Dict:
+        """Chrome/Perfetto `trace_event` format: "X" complete events,
+        microsecond timestamps (chrome://tracing, ui.perfetto.dev)."""
+        pid = os.getpid()
+        events = []
+        for rec in self.spans():
+            ev = {
+                "name": rec["name"],
+                "ph": "X",
+                "ts": round(rec["ts"] * 1e6, 3),
+                "dur": round(rec["dur"] * 1e6, 3),
+                "pid": pid,
+                "tid": rec["tid"],
+                "cat": "lightgbm_tpu",
+            }
+            args = dict(rec.get("attrs", ()))
+            if "parent" in rec:
+                args["parent"] = rec["parent"]
+            if args:
+                ev["args"] = args
+            events.append(ev)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def to_jsonl(self) -> str:
+        return "\n".join(json.dumps(rec) for rec in self.spans())
+
+    def dump(self, path: str, fmt: Optional[str] = None) -> str:
+        """Write the ring to `path`. fmt: "jsonl" | "chrome"; default
+        by extension (.jsonl -> JSONL, anything else -> Chrome JSON).
+        Returns the format written."""
+        if fmt is None:
+            fmt = "jsonl" if str(path).endswith(".jsonl") else "chrome"
+        with open(path, "w") as fh:
+            if fmt == "jsonl":
+                fh.write(self.to_jsonl())
+                fh.write("\n")
+            else:
+                json.dump(self.to_chrome_trace(), fh)
+                fh.write("\n")
+        return fmt

@@ -62,6 +62,7 @@ class Server:
         self._batchers: Dict[str, MicroBatcher] = {}
         self._lock = threading.Lock()
         self._closed = False
+        self._metrics_server = None
 
     @classmethod
     def from_config(cls, config) -> "Server":
@@ -116,6 +117,9 @@ class Server:
         with self._lock:
             self._closed = True
             batchers, self._batchers = dict(self._batchers), {}
+            msrv, self._metrics_server = self._metrics_server, None
+        if msrv is not None:
+            msrv.close()
         for b in batchers.values():
             b.close()
         for name in self.registry.names():
@@ -276,3 +280,36 @@ class Server:
         with open(path, "w") as fh:
             json.dump(self.metrics_snapshot(), fh, indent=2)
             fh.write("\n")
+
+    def prometheus_text(self) -> str:
+        """Prometheus text-exposition (0.0.4) body: per-model request
+        metrics (label model="<name>"), engine-wide bucket-cache
+        counters, serve timers, plus the process-global observability
+        registry (training telemetry, compiles, MFU, reliability)."""
+        from ..observability import registry as _obs
+        from ..observability.export import render_prometheus
+        snap = self.metrics_snapshot()
+        sections = [(m, "lightgbm_tpu_serving_model", {"model": nm})
+                    for nm, m in snap["models"].items()]
+        sections.append((snap["engine"], "lightgbm_tpu_serving_engine",
+                         None))
+        return render_prometheus(sections) + _obs.prometheus_text()
+
+    def start_metrics_server(self, port: int = 0,
+                             host: str = "127.0.0.1"):
+        """Expose GET /metrics (Prometheus text), /healthz and
+        /snapshot (JSON metrics_snapshot) on a daemon thread; port 0
+        binds an ephemeral port. Returns the MetricsHTTPServer (its
+        `.port`/`.url` carry the bound address); closed with the
+        Server. Idempotent — a second call returns the running one."""
+        from ..observability.export import MetricsHTTPServer
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("server is closed")
+            if self._metrics_server is None:
+                self._metrics_server = MetricsHTTPServer(
+                    self.prometheus_text, self.metrics_snapshot,
+                    host=host, port=port)
+                Log.info("serving metrics at %s",
+                         self._metrics_server.url)
+        return self._metrics_server

@@ -102,7 +102,10 @@ def _run_bench(extra_env, timeout=900):
     env.update({
         "JAX_PLATFORMS": "cpu", "BENCH_ROWS": "1500", "BENCH_LEAVES": "7",
         "BENCH_MAX_BIN": "31", "BENCH_TREES": "4", "BENCH_BLOCK_TREES": "2",
-        "BENCH_RETRY_WINDOW": "30", "BENCH_RETRY_INTERVAL": "5"})
+        "BENCH_RETRY_WINDOW": "30", "BENCH_RETRY_INTERVAL": "5",
+        # fault tests exercise the binary headline path only; the task
+        # matrix has its own test below
+        "BENCH_TASKS": ""})
     env.update(extra_env)
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
@@ -126,10 +129,33 @@ class TestBenchSurvivesFaults:
         for key in ("vs_baseline", "vs_single_core", "unit",
                     "serve_qps", "serve_p50_ms", "serve_p95_ms",
                     "serve_p99_ms", "serve_rows_per_sec",
-                    "serve_buckets_compiled", "serve_bucket_hits"):
+                    "serve_buckets_compiled", "serve_bucket_hits",
+                    "achieved_tflops", "mfu_per_tree",
+                    "device_peak_tflops", "tasks"):
             assert key in parsed, key
         # the serve path must have produced a live measurement too
         assert parsed["serve_qps"] > 0, err[-2000:]
+        # CPU run: achieved TFLOP/s still computed from the analytic
+        # MAC model (bench forces the MXU formula), peak unknown -> 0.0
+        assert parsed["achieved_tflops"] > 0, err[-2000:]
+        assert parsed["device_peak_tflops"] == 0.0
+
+    def test_task_matrix_rows(self):
+        # one per-task record (regression, smallest warm-up cost) rides
+        # the same JSON line with the documented schema; tiny tree
+        # counts can leave no measured block (value 0.0) — the metric
+        # must still be real
+        parsed, err = _run_bench({"BENCH_TASKS": "regression",
+                                  "BENCH_TASK_TREES": "8"})
+        assert len(parsed["tasks"]) == 1, err[-2000:]
+        row = parsed["tasks"][0]
+        for key in ("task", "value", "unit", "metric", "metric_value",
+                    "vs_single_core"):
+            assert key in row, key
+        assert row["task"] == "regression"
+        assert row["metric"] == "rmse"
+        assert row["unit"] == "trees/sec"
+        assert row["metric_value"] > 0, err[-2000:]
 
     def test_fault_above_train_many_mid_measurement(self):
         # fault that escapes train_many: bench must re-probe, rebuild

@@ -1,0 +1,417 @@
+"""Unified observability subsystem (lightgbm_tpu/observability/).
+
+Covers: span nesting + thread safety, Chrome/Perfetto + JSONL trace
+round-trips, MFU arithmetic against hand-computed MAC counts, the
+Prometheus text endpoint (scraped over HTTP), per-iteration training
+telemetry from live boosters (normal and fused paths), compile
+accounting, the disabled-path contract (shared null span, empty ring),
+and the custom-fobj constant-hessian regression (Booster.update(fobj)
+must neutralize the objective's is_constant_hessian gate exactly like
+engine.train's objective="none" reset).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.observability import mfu
+from lightgbm_tpu.observability import registry as obs
+from lightgbm_tpu.observability.export import prometheus_lines
+from lightgbm_tpu.observability.trace import Trace, _NULL_SPAN
+
+
+@pytest.fixture(autouse=True)
+def _obs_state():
+    """Each test starts from a clean, disabled registry."""
+    obs.disable()
+    obs.reset()
+    yield
+    obs.disable()
+    obs.reset()
+
+
+def _data(n=400, f=6, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+PARAMS = {"objective": "binary", "num_leaves": 7, "learning_rate": 0.2,
+          "max_bin": 31, "verbosity": -1, "min_data_in_leaf": 5}
+
+
+def _mxu_booster(X, y, extra=None):
+    """Force the fused-eligible MXU path on CPU (interpret mode) after
+    one normal iteration — same trick as test_bench_robustness.py."""
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 31})
+    bst = lgb.Booster(params=dict(PARAMS, **(extra or {})), train_set=ds)
+    bst.update()
+    g = bst.gbdt
+    g._hist_impl = "mxu"
+    g._mxu_interpret = True
+    g._fused_run = None
+    g._obs_tree_macs = None   # path change invalidates the MAC cache
+    return bst
+
+
+# ---------------------------------------------------------------- spans
+class TestSpans:
+    def test_nesting_depth_and_parent(self):
+        tr = Trace()
+        tr.enabled = True
+        with tr.span("outer", x=1):
+            with tr.span("mid"):
+                with tr.span("inner"):
+                    pass
+        by_name = {s["name"]: s for s in tr.spans()}
+        assert by_name["outer"]["depth"] == 0
+        assert "parent" not in by_name["outer"]
+        assert by_name["mid"]["depth"] == 1
+        assert by_name["mid"]["parent"] == "outer"
+        assert by_name["inner"]["depth"] == 2
+        assert by_name["inner"]["parent"] == "mid"
+        assert by_name["outer"]["attrs"] == {"x": 1}
+        # completion order: innermost exits (and lands) first
+        assert [s["name"] for s in tr.spans()] == \
+            ["inner", "mid", "outer"]
+
+    def test_thread_safety_of_nesting(self):
+        tr = Trace(capacity=4096)
+        tr.enabled = True
+        errs = []
+
+        def work(tag):
+            try:
+                for i in range(50):
+                    with tr.span(f"{tag}_outer", i=i):
+                        with tr.span(f"{tag}_inner"):
+                            pass
+            except Exception as exc:  # pragma: no cover
+                errs.append(exc)
+
+        threads = [threading.Thread(target=work, args=(f"t{k}",))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        spans = tr.spans()
+        assert len(spans) == 4 * 50 * 2
+        # per-thread stacks: an inner span's parent is ALWAYS its own
+        # thread's outer, never a concurrent thread's
+        for s in spans:
+            if s["name"].endswith("_inner"):
+                assert s["parent"] == s["name"].replace("_inner",
+                                                        "_outer")
+                assert s["depth"] == 1
+
+    def test_ring_eviction_counts_drops(self):
+        tr = Trace(capacity=16)
+        tr.enabled = True
+        for i in range(30):
+            tr.add(f"s{i}", 0.0, 0.001)
+        assert len(tr) == 16
+        assert tr.dropped == 14
+        assert tr.spans()[0]["name"] == "s14"  # oldest evicted
+
+    def test_disabled_returns_shared_null_span(self):
+        tr = Trace()
+        assert tr.span("a") is _NULL_SPAN
+        assert tr.span("b", k=1) is tr.span("c")
+        with tr.span("a"):
+            pass
+        tr.add("manual", 0.0, 1.0)
+        assert len(tr) == 0
+
+
+# --------------------------------------------------------------- export
+class TestTraceExport:
+    def test_chrome_perfetto_round_trip(self, tmp_path):
+        tr = Trace()
+        tr.enabled = True
+        with tr.span("grow_tree", iteration=3):
+            time.sleep(0.001)
+        path = tmp_path / "trace.json"
+        assert tr.dump(str(path)) == "chrome"
+        doc = json.loads(path.read_text())
+        assert doc["displayTimeUnit"] == "ms"
+        ev = doc["traceEvents"][0]
+        assert ev["ph"] == "X"
+        assert ev["name"] == "grow_tree"
+        assert ev["cat"] == "lightgbm_tpu"
+        assert ev["dur"] >= 1000            # microseconds
+        assert ev["args"]["iteration"] == 3
+        assert {"ts", "pid", "tid"} <= set(ev)
+
+    def test_jsonl_round_trip(self, tmp_path):
+        tr = Trace()
+        tr.enabled = True
+        for i in range(3):
+            tr.add("iter", float(i), 0.5, iteration=i)
+        path = tmp_path / "trace.jsonl"
+        assert tr.dump(str(path)) == "jsonl"
+        recs = [json.loads(ln) for ln in
+                path.read_text().strip().splitlines()]
+        assert len(recs) == 3
+        assert [r["attrs"]["iteration"] for r in recs] == [0, 1, 2]
+        assert all(r["dur"] == 0.5 for r in recs)
+
+
+# ------------------------------------------------------------------ mfu
+class TestMFU:
+    def test_histogram_macs_hand_computed(self):
+        # nchan * S * N_pad * F * B_pad, N padded to the row block and
+        # B to the 128-lane boundary (histogram_mxu.py docstring)
+        macs = mfu.histogram_macs(num_slots=23, num_rows=1000,
+                                  num_features=10, bmax=63, nchan=5)
+        assert macs == 5 * 23 * 4096 * 10 * 128
+
+    def test_hist_channels_mirror_fits_v2(self):
+        assert mfu.hist_channels(double_prec=True) == 5
+        assert mfu.hist_channels(double_prec=False) == 4
+        assert mfu.hist_channels(quantized=True) == 3
+        assert mfu.hist_channels(quantized=True, const_hess=True) == 2
+        assert mfu.hist_channels(const_hess=True) == 3
+
+    def test_tree_macs_hand_computed_schedule(self):
+        # num_leaves=7, overshoot=2.0 -> L_g=14, s_max=15; doubling
+        # schedule 2,4,8,15; subtraction halves slots per pass:
+        # 1+2+4+8 = 15, bridge (15+1)//2 = 8 -> 23 slots total
+        macs = mfu.tree_macs(num_leaves=7, num_rows=1000,
+                             num_features=10, bmax=63, overshoot=2.0)
+        assert macs == 5 * 23 * 4096 * 10 * 128
+
+    def test_tree_macs_no_subtraction_no_overshoot(self):
+        # overshoot off: s_max = num_leaves + 1 = 8; schedule 2,4,8;
+        # full slots 2+4+8 = 14, no bridge
+        macs = mfu.tree_macs(num_leaves=7, num_rows=1000,
+                             num_features=10, bmax=63, overshoot=0.0,
+                             hist_subtraction=False)
+        assert macs == 5 * 14 * 4096 * 10 * 128
+
+    def test_achieved_tflops_and_mfu(self, monkeypatch):
+        assert mfu.achieved_tflops(0.5e12) == 1.0   # 1 MAC = 2 FLOPs
+        assert mfu.mfu_fraction(45.0, 90.0) == 0.5
+        assert mfu.mfu_fraction(45.0, 0.0) is None  # unknown peak
+        monkeypatch.setenv("LGBM_TPU_PEAK_TFLOPS", "918")
+        assert mfu.device_peak_tflops() == 918.0
+        assert mfu.mfu_fraction(91.8) == pytest.approx(0.1)
+
+    def test_device_utilization_accumulator(self, monkeypatch):
+        monkeypatch.setenv("LGBM_TPU_PEAK_TFLOPS", "100")
+        du = mfu.DeviceUtilization()
+        du.add(25e12, 1.0, trees=2)   # 25e12 MACs/s = 50 TFLOP/s
+        snap = du.snapshot()
+        assert snap["trees"] == 2
+        assert snap["achieved_tflops"] == pytest.approx(50.0)
+        assert snap["mfu"] == pytest.approx(0.5)
+
+
+# ----------------------------------------------------------- prometheus
+class TestPrometheus:
+    def test_flattener(self):
+        lines = prometheus_lines(
+            {"a": 1, "nested": {"b": 2.5, "skip": "str"},
+             "flag": True}, "pre")
+        assert "# TYPE pre_a gauge" in lines
+        assert "pre_a 1" in lines
+        assert "pre_nested_b 2.5" in lines
+        assert "pre_flag 1" in lines
+        assert not any("skip" in ln for ln in lines)
+
+    def test_labels_and_name_sanitizing(self):
+        lines = prometheus_lines({"p50 ms": 1.5}, "m",
+                                 labels={"model": 'a"b'})
+        assert 'm_p50_ms{model="a\\"b"} 1.5' in lines
+
+    def test_registry_text_scrapeable_totals(self):
+        obs.enable()
+        obs.compiles.record("fused_train", 2.0, compiled=True)
+        obs.compiles.record("fused_train", 0.0, compiled=False)
+        text = obs.prometheus_text()
+        assert "lightgbm_tpu_observability_enabled 1" in text
+        assert "lightgbm_tpu_compiles_compile_count 1" in text
+        assert "lightgbm_tpu_compiles_hit_count 1" in text
+        assert ("lightgbm_tpu_compiles_entries_fused_train_compiles 1"
+                in text)
+
+    def test_serving_metrics_http_endpoint(self):
+        from lightgbm_tpu.serving import Server
+        X, y = _data()
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 31})
+        bst = lgb.Booster(params=dict(PARAMS), train_set=ds)
+        for _ in range(3):
+            bst.update()
+        with Server(min_bucket=16, max_bucket=64) as srv:
+            srv.load_model("m1", booster=bst)
+            srv.predict("m1", X[:10])
+            msrv = srv.start_metrics_server(port=0)
+            assert msrv.port > 0
+            # idempotent: second call returns the running endpoint
+            assert srv.start_metrics_server() is msrv
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{msrv.port}/metrics",
+                timeout=10).read().decode()
+            assert "# TYPE" in body
+            assert 'lightgbm_tpu_serving_model_requests{model="m1"} 1' \
+                in body
+            assert "lightgbm_tpu_serving_engine_device_batches 1" \
+                in body
+            ok = urllib.request.urlopen(
+                f"http://127.0.0.1:{msrv.port}/healthz",
+                timeout=10).read()
+            assert ok == b"ok\n"
+            snap = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{msrv.port}/snapshot",
+                timeout=10).read())
+            assert snap["models"]["m1"]["requests"] == 1
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{msrv.port}/nope", timeout=10)
+        # server close shuts the endpoint down
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{msrv.port}/healthz", timeout=2)
+
+
+# ------------------------------------------------------ train telemetry
+class TestTrainingTelemetry:
+    def test_per_iteration_records(self):
+        X, y = _data()
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 31})
+        bst = lgb.Booster(params=dict(PARAMS, observe=True,
+                                      observe_norms=True),
+                          train_set=ds)
+        assert obs.enabled
+        for _ in range(4):
+            bst.update()
+        snap = obs.snapshot()
+        assert snap["training"]["iterations"] == 4
+        assert snap["training"]["trees"] == 4
+        last = snap["training"]["last"]
+        assert last["iteration"] == 3
+        assert last["wall_s"] > 0
+        assert "tree_train" in last["phases"]
+        assert last["grad_norm"] > 0
+        assert last["hess_norm"] > 0
+        assert last["leaves"] >= 2
+        # span trace mirrors the iterations
+        names = [s["name"] for s in obs.trace.spans()]
+        assert names.count("train_iter") == 4
+
+    def test_fused_block_record_and_compile_accounting(self):
+        X, y = _data(seed=8)
+        obs.enable()
+        bst = _mxu_booster(X, y)
+        bst.update_batch(3)
+        last = obs.training.last()
+        assert last["fused"] is True
+        assert last["iterations"] == 3
+        assert last["trees"] == 3
+        # the forced-MXU booster has an analytic MAC model -> MFU
+        # accumulates estimated MACs for the block
+        assert last["estimated_macs"] > 0
+        comp = obs.compiles.snapshot()
+        assert comp["fused_train"]["compiles"] == 1
+        assert comp["fused_train"]["compile_seconds"] > 0
+        bst.update_batch(2)
+        comp = obs.compiles.snapshot()
+        assert comp["fused_train"]["compiles"] == 1
+        assert comp["fused_train"]["hits"] == 1
+        du = obs.mfu.snapshot()
+        assert du["estimated_macs"] == obs.tree_macs_for(bst.gbdt) * 5
+        assert du["trees"] == 5
+
+    def test_counter_deltas_fold_into_records(self):
+        X, y = _data(seed=9)
+        obs.enable()
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 31})
+        bst = lgb.Booster(params=dict(PARAMS), train_set=ds)
+        bst.update()
+        obs.counters.inc("guard_trips")
+        bst.update()
+        recs = obs.training.records()
+        assert recs[-1]["counters"]["guard_trips"] == 1
+        bst.update()
+        assert "counters" not in obs.training.records()[-1]
+
+    def test_disabled_path_records_nothing(self):
+        X, y = _data(seed=10)
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 31})
+        bst = lgb.Booster(params=dict(PARAMS), train_set=ds)
+        for _ in range(3):
+            bst.update()
+        assert obs.training.iterations == 0
+        assert len(obs.trace) == 0
+        assert obs.compiles.snapshot() == {}
+
+    def test_disabled_span_overhead_smoke(self):
+        # the off path is one attribute read + branch; 10k no-op spans
+        # must be far under one training iteration's wall (~ms). Loose
+        # bound: 50ms even on a loaded CI box.
+        t0 = time.perf_counter()
+        for _ in range(10_000):
+            with obs.trace.span("x"):
+                pass
+        assert time.perf_counter() - t0 < 0.05
+        assert len(obs.trace) == 0
+
+
+# -------------------------------------------- custom-fobj const-hessian
+class TestCustomObjectiveConstHessian:
+    def _scaled_l2(self, y):
+        def fobj(score, ds_):
+            return 2.0 * (score - y), np.full_like(score, 2.0)
+        return fobj
+
+    def test_update_fobj_neutralizes_const_hessian_gate(self):
+        X, y = _data(seed=11)
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 31})
+        bst = lgb.Booster(params=dict(PARAMS, objective="regression"),
+                          train_set=ds)
+        assert bst.gbdt._const_hessian() == 1.0
+        bst.update(fobj=self._scaled_l2(y))
+        # the objective still claims constant hessians, but the
+        # gradients trained on are the user's — the gate must be off
+        # (reference mirrors this by resetting objective to "none")
+        assert bst.gbdt._const_hessian() == 0.0
+
+    def test_update_fobj_matches_objective_none_on_mxu(self):
+        # pre-fix failure mode: objective="regression" + update(fobj)
+        # kept const_hessian=1.0, so the MXU kernel dropped the hessian
+        # channel and reconstructed h as the row count (1.0/row) —
+        # silently wrong for any fobj with hessians != count. With the
+        # gate fixed, the model must be identical to the one trained
+        # with objective="none" (the engine.train normalization).
+        X, y = _data(seed=12)
+        fobj = self._scaled_l2(y)
+        boosters = []
+        for objective in ("regression", "none"):
+            ds = lgb.Dataset(X, label=y, params={"max_bin": 31})
+            bst = lgb.Booster(
+                params=dict(PARAMS, objective=objective,
+                            boost_from_average=False), train_set=ds)
+            bst.update(fobj=fobj)      # iteration 0: normal path
+            g = bst.gbdt
+            g._hist_impl = "mxu"
+            g._mxu_interpret = True
+            g._fused_run = None
+            for _ in range(3):
+                bst.update(fobj=fobj)  # MXU path, custom hessians
+            boosters.append(bst)
+        a, b = boosters
+        assert a.gbdt._const_hessian() == b.gbdt._const_hessian() == 0.0
+        # identical trees; only the objective= header lines may differ
+        def _trees(s):
+            return "\n".join(ln for ln in s.splitlines()
+                             if "objective" not in ln)
+        assert _trees(a.model_to_string()) == _trees(b.model_to_string())
+        np.testing.assert_array_equal(np.asarray(a.gbdt.train_score),
+                                      np.asarray(b.gbdt.train_score))
