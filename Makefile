@@ -7,10 +7,17 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-all faults observe bench install
+.PHONY: test test-slow test-all faults observe lint bench install
 
 test:
 	$(PY) -m pytest tests/ -x -q
+
+# tpulint: AST invariant checker (jit hygiene, lock discipline, registry
+# consistency — docs/StaticAnalysis.md); exits non-zero on any
+# unsuppressed finding, plus the rule-engine's own fixture tests
+lint:
+	$(PY) -m lightgbm_tpu.analysis lightgbm_tpu --format=json
+	$(PY) -m pytest tests/test_static_analysis.py -x -q -m lint
 
 # the fault-injection tier: every registered reliability site fired and
 # recovered (tests/test_reliability.py, docs/Reliability.md)

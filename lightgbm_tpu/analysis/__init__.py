@@ -1,0 +1,15 @@
+"""tpulint: AST-based invariant checker for this codebase.
+
+Run as ``python -m lightgbm_tpu.analysis [paths...]`` (defaults to the
+installed package). Rule catalogue and suppression syntax:
+docs/StaticAnalysis.md. Wired into ``make lint`` and enforced at
+zero unsuppressed findings by tests/test_static_analysis.py (tier-1).
+"""
+
+from .engine import (Analyzer, Finding, ParsedFile, ProjectContext,
+                     ProjectRule, Rule, all_rules)
+
+__all__ = [
+    "Analyzer", "Finding", "ParsedFile", "ProjectContext", "ProjectRule",
+    "Rule", "all_rules",
+]

@@ -1,0 +1,56 @@
+"""CLI entry: ``python -m lightgbm_tpu.analysis [paths...]``.
+
+Exit status 0 iff zero unsuppressed findings — the contract
+tests/test_static_analysis.py enforces as a tier-1 test.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from .engine import Analyzer, all_rules
+
+
+def _default_paths() -> List[str]:
+    # the package this module ships in
+    return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu.analysis",
+        description="tpulint: AST invariant checker (jit hygiene, lock "
+                    "discipline, registry consistency). See "
+                    "docs/StaticAnalysis.md.")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories to scan (default: the "
+                             "installed lightgbm_tpu package)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text", help="output format")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="also print suppressed findings (text mode)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalogue and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id} [{rule.severity}] {rule.doc}")
+        return 0
+
+    paths = args.paths or _default_paths()
+    analyzer = Analyzer()
+    findings = analyzer.run(paths)
+    if args.format == "json":
+        print(Analyzer.render_json(findings))
+    else:
+        print(Analyzer.render_text(findings,
+                                   show_suppressed=args.show_suppressed))
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,295 @@
+"""tpulint rule engine: file walker, visitor registry, findings.
+
+The codebase's correctness rests on conventions no runtime test can
+enforce cheaply: `jax.jit` recompile contracts (`static_argnames`),
+`with self._lock` discipline around shared-state classes, and a
+registry of ~160 config parameters mirrored in docs and the CLI. The
+reference LightGBM leans on C++ sanitizers and compile-time checks for
+this class of bug; a JAX port needs its own analyzer, because the
+costliest failures on TPU are *silent* — unbounded recompilation and
+host syncs in the hot path (PAPERS.md: arxiv 1706.08359 on dispatch
+overhead dominating small-batch training, arxiv 2011.02022 on keeping
+the per-tree inner loop device-resident).
+
+Architecture:
+
+- `ParsedFile`: one source file — path, source, `ast` tree, per-line
+  suppression sets parsed from ``# tpulint: disable=RULE[,RULE...]``
+  comments (``disable=all`` silences every rule on that line;
+  ``disable-file=`` applies to the whole file).
+- `Rule`: per-file analysis (`check(parsed) -> findings`).
+- `ProjectRule`: whole-project analysis (`check_project(files, ctx)`)
+  for cross-file invariants — registry consistency, lock-order graphs.
+- `Analyzer`: walks the target paths, parses once, runs every rule,
+  marks suppressed findings, renders text/JSON.
+
+Exit contract (enforced by tests/test_static_analysis.py as a tier-1
+test): `python -m lightgbm_tpu.analysis lightgbm_tpu/` exits 0 iff the
+package has zero unsuppressed findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence
+
+__all__ = [
+    "Finding", "ParsedFile", "Rule", "ProjectRule", "Analyzer",
+    "all_rules", "DEVICE_DIRS",
+]
+
+#: package subdirectories whose code runs (or stages) device compute;
+#: the jit-hygiene and dtype rules only apply here.
+DEVICE_DIRS = ("learner", "serving", "parallel", "boosting")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*(disable|disable-file)\s*=\s*"
+    r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One analyzer hit, pinned to file:line."""
+    rule: str
+    severity: str          # "error" | "warning"
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+
+    def render(self) -> str:
+        sup = " (suppressed)" if self.suppressed else ""
+        return (f"{self.path}:{self.line}: {self.severity} "
+                f"[{self.rule}]{sup} {self.message}")
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+class ParsedFile:
+    """One parsed source file plus its suppression comments."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(source, filename=path)
+        except SyntaxError as exc:
+            self.parse_error = str(exc)
+        # line number -> set of rule ids disabled on that line
+        self.line_suppressions: Dict[int, set] = {}
+        self.file_suppressions: set = set()
+        for lineno, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
+            if m.group(1) == "disable-file":
+                self.file_suppressions |= rules
+            else:
+                self.line_suppressions.setdefault(lineno, set()).update(
+                    rules)
+
+    # ------------------------------------------------------------------
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_suppressions or \
+                "all" in self.file_suppressions:
+            return True
+        on_line = self.line_suppressions.get(line, ())
+        return rule in on_line or "all" in on_line
+
+    def rel_path(self, root: str) -> str:
+        try:
+            return os.path.relpath(self.path, root)
+        except ValueError:          # different drive (windows)
+            return self.path
+
+    def in_device_dir(self) -> bool:
+        parts = os.path.normpath(self.path).split(os.sep)
+        return any(d in parts for d in DEVICE_DIRS)
+
+
+class Rule:
+    """Per-file rule. Subclasses set `id`/`severity`/`doc` and
+    implement `check`."""
+
+    id: str = "RULE000"
+    severity: str = "error"
+    doc: str = ""
+
+    def check(self, parsed: ParsedFile) -> List[Finding]:
+        raise NotImplementedError
+
+    def finding(self, parsed: ParsedFile, line: int,
+                message: str) -> Finding:
+        return Finding(rule=self.id, severity=self.severity,
+                       path=parsed.path, line=line, message=message)
+
+
+class ProjectRule(Rule):
+    """Whole-project rule: sees every parsed file plus the repo layout
+    (docs/, tests/) resolved from the package location."""
+
+    def check(self, parsed: ParsedFile) -> List[Finding]:
+        return []
+
+    def check_project(self, files: Sequence[ParsedFile],
+                      ctx: "ProjectContext") -> List[Finding]:
+        raise NotImplementedError
+
+
+class ProjectContext:
+    """Repo layout for cross-file rules: where the package, docs and
+    tests live. Resolved from the scanned package directory (the one
+    holding config.py), falling back to the installed package."""
+
+    def __init__(self, files: Sequence[ParsedFile]):
+        pkg_dir = None
+        for f in files:
+            if os.path.basename(f.path) == "config.py":
+                pkg_dir = os.path.dirname(os.path.abspath(f.path))
+                break
+        if pkg_dir is None and files:
+            pkg_dir = os.path.dirname(os.path.abspath(files[0].path))
+        if pkg_dir is None:
+            pkg_dir = os.path.dirname(os.path.abspath(__file__))
+            pkg_dir = os.path.dirname(pkg_dir)
+        self.package_dir = pkg_dir
+        self.repo_root = os.path.dirname(pkg_dir)
+        self.docs_dir = os.path.join(self.repo_root, "docs")
+        self.tests_dir = os.path.join(self.repo_root, "tests")
+
+    def read_doc(self, name: str) -> Optional[str]:
+        path = os.path.join(self.docs_dir, name)
+        try:
+            with open(path, "r") as fh:
+                return fh.read()
+        except OSError:
+            return None
+
+    def read_tests(self) -> str:
+        """Concatenated tests/*.py sources (site-name cross checks)."""
+        chunks = []
+        try:
+            names = sorted(os.listdir(self.tests_dir))
+        except OSError:
+            return ""
+        for name in names:
+            if not name.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(self.tests_dir, name)) as fh:
+                    chunks.append(fh.read())
+            except OSError:
+                continue
+        return "\n".join(chunks)
+
+
+def _iter_py_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                out.append(path)
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in ("__pycache__", ".git"))
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    out.append(os.path.join(root, name))
+    return out
+
+
+def all_rules() -> List[Rule]:
+    """The registered rule set, id-ordered."""
+    from .rules_faults import FaultCoverageRule
+    from .rules_jit import (DtypeF64Rule, DtypePromotionRule,
+                            JitHostSyncRule, JitPythonControlFlowRule,
+                            JitStaticScalarRule)
+    from .rules_lock import LockDisciplineRule, LockOrderRule
+    from .rules_registry import (CliTaskRoutingRule, ConfigAttrRule,
+                                 FaultSiteRegistryRule, ParamDocsRule,
+                                 PrometheusDocsRule)
+    rules: List[Rule] = [
+        JitStaticScalarRule(), JitPythonControlFlowRule(),
+        JitHostSyncRule(), DtypeF64Rule(), DtypePromotionRule(),
+        LockDisciplineRule(), LockOrderRule(),
+        ParamDocsRule(), CliTaskRoutingRule(), ConfigAttrRule(),
+        FaultSiteRegistryRule(), PrometheusDocsRule(),
+        FaultCoverageRule(),
+    ]
+    return sorted(rules, key=lambda r: r.id)
+
+
+class Analyzer:
+    """Run every rule over the target paths; collect findings."""
+
+    def __init__(self, rules: Optional[Sequence[Rule]] = None):
+        self.rules = list(rules) if rules is not None else all_rules()
+
+    # ------------------------------------------------------------------
+    def parse_paths(self, paths: Iterable[str]) -> List[ParsedFile]:
+        files = []
+        for path in _iter_py_files(paths):
+            try:
+                with open(path, "r") as fh:
+                    source = fh.read()
+            except OSError as exc:
+                files.append(ParsedFile(path, ""))
+                files[-1].parse_error = str(exc)
+                continue
+            files.append(ParsedFile(path, source))
+        return files
+
+    def run(self, paths: Iterable[str]) -> List[Finding]:
+        files = self.parse_paths(paths)
+        ctx = ProjectContext(files)
+        findings: List[Finding] = []
+        by_path = {f.path: f for f in files}
+        for parsed in files:
+            if parsed.parse_error is not None:
+                findings.append(Finding(
+                    rule="PARSE001", severity="error", path=parsed.path,
+                    line=1,
+                    message=f"file does not parse: {parsed.parse_error}"))
+                continue
+            for rule in self.rules:
+                findings.extend(rule.check(parsed))
+        for rule in self.rules:
+            if isinstance(rule, ProjectRule):
+                findings.extend(rule.check_project(files, ctx))
+        for f in findings:
+            parsed = by_path.get(f.path)
+            if parsed is not None and parsed.is_suppressed(f.rule, f.line):
+                f.suppressed = True
+        findings.sort(key=lambda f: (f.path, f.line, f.rule))
+        return findings
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def render_text(findings: Sequence[Finding],
+                    show_suppressed: bool = False) -> str:
+        shown = [f for f in findings
+                 if show_suppressed or not f.suppressed]
+        lines = [f.render() for f in shown]
+        n_sup = sum(1 for f in findings if f.suppressed)
+        lines.append(f"tpulint: {len([f for f in findings if not f.suppressed])} "
+                     f"finding(s), {n_sup} suppressed")
+        return "\n".join(lines)
+
+    @staticmethod
+    def render_json(findings: Sequence[Finding]) -> str:
+        active = [f for f in findings if not f.suppressed]
+        return json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "unsuppressed": len(active),
+            "suppressed": len(findings) - len(active),
+        }, indent=2)

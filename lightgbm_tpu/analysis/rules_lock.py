@@ -1,0 +1,335 @@
+"""Lock-discipline rules: unlocked shared state + lock-order cycles.
+
+Ten classes in this codebase guard shared state with a `self._lock`
+(the serving batcher/registry/metrics, the observability ring buffers,
+the fault registry...). The convention the checker enforces:
+
+LOCK001 — in any class whose `__init__` creates `self._lock`
+(threading.Lock/RLock/Condition), every read or write of an
+underscore-prefixed instance attribute that is *mutated after
+construction* must happen inside a `with self._lock:` block.
+Attributes only assigned in `__init__` are read-only after
+construction and exempt (e.g. a worker Thread handle, a
+threading.local). Methods whose names end in `_locked` are exempt —
+the naming contract says "caller holds the lock". Nested functions
+and lambdas count as unlocked contexts: they usually escape the
+method and run later on another thread.
+
+LOCK002 — a cross-class lock-acquisition-order graph: an edge A -> B
+is recorded when code holding A's lock calls a method of class B that
+acquires B's own lock. A cycle in that graph is a lock-inversion
+hazard (thread 1 holds A waiting for B, thread 2 holds B waiting for
+A) — the lightweight race detector for the serving batcher +
+observability registry threads. Method-name matching is intentionally
+conservative: names that collide with builtin container methods
+(`get`, `add`, `clear`, ...) never create edges.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .engine import Finding, ParsedFile, ProjectContext, ProjectRule, Rule
+
+__all__ = ["LockDisciplineRule", "LockOrderRule", "collect_lock_classes"]
+
+_LOCK_FACTORIES = ("Lock", "RLock", "Condition")
+
+#: method names too generic to attribute to a lock class (they collide
+#: with dict/list/set methods on plain containers)
+_GENERIC_METHODS = frozenset((
+    "get", "set", "add", "pop", "clear", "update", "remove", "append",
+    "extend", "insert", "count", "index", "copy", "keys", "values",
+    "items", "setdefault", "sort", "join", "split", "close", "start",
+))
+
+
+def _is_lock_ctor(expr: ast.expr) -> bool:
+    """True for threading.Lock() / Lock() / threading.Condition(...)."""
+    if not isinstance(expr, ast.Call):
+        return False
+    fn = expr.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else \
+        fn.id if isinstance(fn, ast.Name) else None
+    return name in _LOCK_FACTORIES
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'x' when node is `self.x`, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class LockClass:
+    """Per-class lock model: lock attrs, guarded attrs, methods."""
+
+    def __init__(self, node: ast.ClassDef, path: str):
+        self.node = node
+        self.path = path
+        self.name = node.name
+        self.methods: Dict[str, ast.FunctionDef] = {
+            n.name: n for n in node.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self.lock_attrs: Set[str] = set()
+        init = self.methods.get("__init__")
+        if init is not None:
+            for sub in ast.walk(init):
+                if isinstance(sub, ast.Assign) and \
+                        _is_lock_ctor(sub.value):
+                    for tgt in sub.targets:
+                        attr = _self_attr(tgt)
+                        if attr is not None:
+                            self.lock_attrs.add(attr)
+        self.guarded_attrs = self._find_guarded() if self.lock_attrs \
+            else set()
+        # methods that acquire the lock somewhere in their own body
+        self.acquiring_methods: Set[str] = {
+            name for name, fn in self.methods.items()
+            if name != "__init__" and self._acquires_lock(fn)}
+
+    # ------------------------------------------------------------------
+    def _find_guarded(self) -> Set[str]:
+        """Underscore attrs written outside __init__ = shared mutable
+        state that the lock must guard everywhere."""
+        guarded: Set[str] = set()
+        for name, fn in self.methods.items():
+            if name == "__init__":
+                continue
+            for sub in ast.walk(fn):
+                targets: List[ast.expr] = []
+                if isinstance(sub, ast.Assign):
+                    targets = list(sub.targets)
+                elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [sub.target]
+                elif isinstance(sub, ast.Delete):
+                    targets = list(sub.targets)
+                for tgt in targets:
+                    # tuple unpack: (a, self._x) = ...
+                    parts = tgt.elts if isinstance(
+                        tgt, (ast.Tuple, ast.List)) else [tgt]
+                    for part in parts:
+                        # container mutation counts: self._x[k] = v,
+                        # del self._x[k]
+                        while isinstance(part, (ast.Subscript,
+                                                ast.Starred)):
+                            part = part.value
+                        attr = _self_attr(part)
+                        if attr and attr.startswith("_") and \
+                                not attr.startswith("__") and \
+                                attr not in self.lock_attrs and \
+                                attr not in self.methods:
+                            guarded.add(attr)
+        return guarded
+
+    def _acquires_lock(self, fn: ast.FunctionDef) -> bool:
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.With):
+                for item in sub.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr in self.lock_attrs:
+                        return True
+        return False
+
+
+def collect_lock_classes(parsed: ParsedFile) -> List[LockClass]:
+    if parsed.tree is None:
+        return []
+    out = []
+    for node in ast.walk(parsed.tree):
+        if isinstance(node, ast.ClassDef):
+            lc = LockClass(node, parsed.path)
+            if lc.lock_attrs:
+                out.append(lc)
+    return out
+
+
+class _LockWalker:
+    """Statement walker tracking whether self's lock is held, reporting
+    guarded-attr touches outside it and (for LOCK002) method calls made
+    while holding it."""
+
+    def __init__(self, cls: LockClass):
+        self.cls = cls
+        self.violations: List[Tuple[int, str, str]] = []  # line, attr, meth
+        self.locked_calls: List[Tuple[int, str]] = []     # line, meth name
+
+    def walk_method(self, fn: ast.FunctionDef) -> None:
+        exempt = (fn.name == "__init__" or fn.name == "__del__" or
+                  fn.name.endswith("_locked"))
+        self._walk_body(fn.body, locked=False, method=fn.name,
+                        exempt=exempt)
+
+    # ------------------------------------------------------------------
+    def _walk_body(self, stmts: Sequence[ast.stmt], locked: bool,
+                   method: str, exempt: bool) -> None:
+        for stmt in stmts:
+            self._walk_stmt(stmt, locked, method, exempt)
+
+    def _walk_stmt(self, stmt: ast.stmt, locked: bool, method: str,
+                   exempt: bool) -> None:
+        if isinstance(stmt, ast.With):
+            acquires = any(
+                _self_attr(item.context_expr) in self.cls.lock_attrs
+                for item in stmt.items)
+            for item in stmt.items:
+                self._scan_expr(item.context_expr, locked, method, exempt)
+            self._walk_body(stmt.body, locked or acquires, method, exempt)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # nested defs escape the method and run later (futures,
+            # worker threads): treat their bodies as unlocked
+            self._walk_body(stmt.body, locked=False, method=method,
+                            exempt=exempt)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            for sub in stmt.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    self._walk_body(sub.body, locked=False, method=method,
+                                    exempt=exempt)
+            return
+        # generic statement: scan expressions, recurse into blocks
+        for field in ("test", "iter", "value", "exc", "msg", "target",
+                      "targets"):
+            val = getattr(stmt, field, None)
+            if isinstance(val, ast.expr):
+                self._scan_expr(val, locked, method, exempt)
+            elif isinstance(val, list):
+                for v in val:
+                    if isinstance(v, ast.expr):
+                        self._scan_expr(v, locked, method, exempt)
+        if isinstance(stmt, ast.Expr):
+            self._scan_expr(stmt.value, locked, method, exempt)
+        for block in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, block, None)
+            if isinstance(sub, list) and sub and \
+                    isinstance(sub[0], ast.stmt):
+                self._walk_body(sub, locked, method, exempt)
+        for handler in getattr(stmt, "handlers", ()):
+            self._walk_body(handler.body, locked, method, exempt)
+
+    def _scan_expr(self, expr: ast.expr, locked: bool, method: str,
+                   exempt: bool) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue    # handled as statements where relevant
+            attr = _self_attr(node)
+            if attr is None:
+                continue
+            if locked and isinstance(node, ast.Attribute):
+                pass
+            if attr in self.cls.guarded_attrs and not locked and \
+                    not exempt and attr not in self.cls.methods:
+                self.violations.append(
+                    (node.lineno, attr, method))
+            if locked:
+                # record method calls made while holding the lock:
+                # self.<obj>.<meth>(...) or <name>.<meth>(...) handled
+                # by the caller via full-expression scan
+                pass
+        if locked:
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute):
+                    meth = node.func.attr
+                    if meth not in _GENERIC_METHODS:
+                        self.locked_calls.append((node.lineno, meth))
+
+
+class LockDisciplineRule(Rule):
+    id = "LOCK001"
+    doc = ("read/write of a lock-guarded underscore attribute outside "
+           "`with self._lock:` in a class that creates self._lock — "
+           "torn reads / lost updates under the serving and "
+           "observability threads")
+
+    def check(self, parsed: ParsedFile) -> List[Finding]:
+        findings: List[Finding] = []
+        for cls in collect_lock_classes(parsed):
+            walker = _LockWalker(cls)
+            for fn in cls.methods.values():
+                walker.walk_method(fn)
+            seen = set()
+            for line, attr, method in walker.violations:
+                key = (line, attr)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(self.finding(
+                    parsed, line,
+                    f"{cls.name}.{method}: access to guarded attribute "
+                    f"'self.{attr}' outside `with self.<lock>:` "
+                    f"(guarded because it is written post-__init__)"))
+        return findings
+
+
+class LockOrderRule(ProjectRule):
+    id = "LOCK002"
+    doc = ("cycle in the cross-class lock-acquisition-order graph: "
+           "holding class A's lock while calling into class B's "
+           "lock-acquiring method, and vice versa — deadlock hazard "
+           "between library threads")
+
+    def check_project(self, files: Sequence[ParsedFile],
+                      ctx: ProjectContext) -> List[Finding]:
+        classes: List[Tuple[LockClass, ParsedFile]] = []
+        for parsed in files:
+            for cls in collect_lock_classes(parsed):
+                classes.append((cls, parsed))
+        # method name -> owning lock classes (for edge resolution)
+        owners: Dict[str, List[LockClass]] = {}
+        for cls, _ in classes:
+            for meth in cls.acquiring_methods:
+                if meth not in _GENERIC_METHODS:
+                    owners.setdefault(meth, []).append(cls)
+        # build edges: call under A's lock to a lock-acquiring method
+        edges: Dict[str, Set[str]] = {}
+        sites: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for cls, parsed in classes:
+            walker = _LockWalker(cls)
+            for fn in cls.methods.values():
+                walker.walk_method(fn)
+            for line, meth in walker.locked_calls:
+                for target in owners.get(meth, ()):  # may be ambiguous
+                    if target.name == cls.name:
+                        continue
+                    edges.setdefault(cls.name, set()).add(target.name)
+                    sites.setdefault((cls.name, target.name),
+                                     (parsed.path, line))
+        findings: List[Finding] = []
+        for cycle in self._find_cycles(edges):
+            a, b = cycle[0], cycle[1 % len(cycle)]
+            path, line = sites.get((a, b), ("<project>", 1))
+            findings.append(Finding(
+                rule=self.id, severity=self.severity, path=path,
+                line=line,
+                message=("lock-order cycle between classes: "
+                         + " -> ".join(cycle + [cycle[0]])
+                         + " (lock inversion / deadlock hazard)")))
+        return findings
+
+    @staticmethod
+    def _find_cycles(edges: Dict[str, Set[str]]) -> List[List[str]]:
+        """Simple cycles via DFS; each cycle reported once, rotated to
+        its lexicographically smallest node."""
+        cycles: Set[Tuple[str, ...]] = set()
+
+        def dfs(node: str, path: List[str], on_path: Set[str]) -> None:
+            for nxt in sorted(edges.get(node, ())):
+                if nxt in on_path:
+                    i = path.index(nxt)
+                    cyc = path[i:]
+                    k = cyc.index(min(cyc))
+                    cycles.add(tuple(cyc[k:] + cyc[:k]))
+                    continue
+                if len(path) < 16:
+                    dfs(nxt, path + [nxt], on_path | {nxt})
+
+        for start in sorted(edges):
+            dfs(start, [start], {start})
+        return [list(c) for c in sorted(cycles)]

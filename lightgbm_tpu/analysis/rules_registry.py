@@ -1,0 +1,409 @@
+"""Registry-consistency rules.
+
+The repo carries three registries whose consumers live in other files:
+the ~160-entry config parameter registry (config.py `_PARAMS`) mirrored
+in docs/Parameters.md and routed by cli.py, the named fault sites
+(reliability/faults.py `KNOWN_SITES`) exercised by tests and documented
+in docs/Reliability.md, and the Prometheus metric families emitted by
+the observability/serving exporters and documented in
+docs/Observability.md. Drift between a registry and its mirrors is
+exactly the class of bug that passes every runtime test (nothing
+*executes* a doc row) — so these rules diff the registries against
+their mirrors structurally.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .engine import Finding, ParsedFile, ProjectContext, ProjectRule
+
+__all__ = [
+    "ParamDocsRule", "CliTaskRoutingRule", "ConfigAttrRule",
+    "FaultSiteRegistryRule", "PrometheusDocsRule",
+]
+
+
+def _find_file(files: Sequence[ParsedFile],
+               basename: str) -> Optional[ParsedFile]:
+    for f in files:
+        if os.path.basename(f.path) == basename and f.tree is not None:
+            return f
+    return None
+
+
+def _str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _collect_params(config: ParsedFile) -> List[Tuple[str, Tuple[str, ...],
+                                                      int]]:
+    """(name, aliases, lineno) for every `_p(...)` registry entry."""
+    out = []
+    for node in ast.walk(config.tree):
+        if not (isinstance(node, ast.Call) and
+                isinstance(node.func, ast.Name) and
+                node.func.id == "_p" and node.args):
+            continue
+        name = _str_const(node.args[0])
+        if name is None:
+            continue
+        aliases: Tuple[str, ...] = ()
+        alias_node = node.args[3] if len(node.args) > 3 else None
+        for kw in node.keywords:
+            if kw.arg == "aliases":
+                alias_node = kw.value
+        if isinstance(alias_node, (ast.Tuple, ast.List)):
+            aliases = tuple(a for a in
+                            (_str_const(e) for e in alias_node.elts)
+                            if a is not None)
+        out.append((name, aliases, node.lineno))
+    return out
+
+
+class ParamDocsRule(ProjectRule):
+    id = "REG001"
+    doc = ("config.py `_PARAMS` and docs/Parameters.md must agree: every "
+           "param has a doc row with the same alias set, no stale rows, "
+           "no duplicate/colliding aliases, matching total count "
+           "(regenerate with helpers/generate_parameter_docs.py)")
+
+    _ROW_RE = re.compile(r"^\|\s*`(\w+)`\s*\|[^|]*\|[^|]*\|([^|]*)\|")
+    _TOTAL_RE = re.compile(r"Total:\s*(\d+)\s*parameters")
+
+    def check_project(self, files: Sequence[ParsedFile],
+                      ctx: ProjectContext) -> List[Finding]:
+        config = _find_file(files, "config.py")
+        if config is None:
+            return []
+        findings: List[Finding] = []
+        params = _collect_params(config)
+        doc = ctx.read_doc("Parameters.md")
+        if doc is None:
+            findings.append(Finding(
+                rule=self.id, severity=self.severity, path=config.path,
+                line=1, message="docs/Parameters.md is missing — run "
+                "helpers/generate_parameter_docs.py"))
+            return findings
+        doc_rows: Dict[str, Set[str]] = {}
+        for line in doc.splitlines():
+            m = self._ROW_RE.match(line.strip())
+            if m and m.group(1) != "Parameter":
+                cell = m.group(2)
+                doc_rows[m.group(1)] = set(re.findall(r"`(\w+)`", cell))
+        # param <-> doc row diff
+        names = {name for name, _, _ in params}
+        for name, aliases, lineno in params:
+            if name not in doc_rows:
+                findings.append(Finding(
+                    rule=self.id, severity=self.severity,
+                    path=config.path, line=lineno,
+                    message=f"param '{name}' has no row in "
+                    f"docs/Parameters.md (regenerate the doc)"))
+            elif doc_rows[name] != set(aliases):
+                findings.append(Finding(
+                    rule=self.id, severity=self.severity,
+                    path=config.path, line=lineno,
+                    message=f"param '{name}' alias set drifted from "
+                    f"docs/Parameters.md: registry={sorted(aliases)} "
+                    f"doc={sorted(doc_rows[name])}"))
+        for row in doc_rows:
+            if row not in names:
+                findings.append(Finding(
+                    rule=self.id, severity=self.severity,
+                    path=config.path, line=1,
+                    message=f"docs/Parameters.md documents '{row}' which "
+                    f"is not in the config.py registry (stale row)"))
+        m = self._TOTAL_RE.search(doc)
+        if m and int(m.group(1)) != len(params):
+            findings.append(Finding(
+                rule=self.id, severity=self.severity, path=config.path,
+                line=1,
+                message=f"docs/Parameters.md total says {m.group(1)} "
+                f"params but the registry has {len(params)}"))
+        # alias sanity inside the registry itself
+        owner: Dict[str, str] = {}
+        for name, aliases, lineno in params:
+            for alias in aliases:
+                if alias in names:
+                    findings.append(Finding(
+                        rule=self.id, severity=self.severity,
+                        path=config.path, line=lineno,
+                        message=f"alias '{alias}' of param '{name}' "
+                        f"collides with a canonical param name"))
+                elif alias in owner and owner[alias] != name:
+                    findings.append(Finding(
+                        rule=self.id, severity=self.severity,
+                        path=config.path, line=lineno,
+                        message=f"alias '{alias}' claimed by both "
+                        f"'{owner[alias]}' and '{name}'"))
+                else:
+                    owner[alias] = name
+        return findings
+
+
+def _task_values_from_config(config: ParsedFile) -> Tuple[Set[str], int]:
+    """Allowed `task` values: the `v in (...)` tuple inside the task
+    param's check lambda."""
+    for node in ast.walk(config.tree):
+        if not (isinstance(node, ast.Call) and
+                isinstance(node.func, ast.Name) and
+                node.func.id == "_p" and node.args and
+                _str_const(node.args[0]) == "task"):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Compare) and \
+                    any(isinstance(op, ast.In) for op in sub.ops):
+                vals = set()
+                for comp in sub.comparators:
+                    if isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                        vals |= {v for v in
+                                 (_str_const(e) for e in comp.elts)
+                                 if v is not None}
+                return vals, node.lineno
+    return set(), 1
+
+
+def _task_values_from_cli(cli: ParsedFile) -> Tuple[Set[str], int]:
+    """Task values `Application.run` dispatches on: every string
+    compared (==/in) against a name called `task` inside run()."""
+    for node in ast.walk(cli.tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "run":
+            vals: Set[str] = set()
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Compare):
+                    continue
+                names = [n.id for n in ast.walk(sub)
+                         if isinstance(n, ast.Name)]
+                if "task" not in names:
+                    continue
+                for comp in sub.comparators:
+                    v = _str_const(comp)
+                    if v is not None:
+                        vals.add(v)
+                    elif isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                        vals |= {v for v in
+                                 (_str_const(e) for e in comp.elts)
+                                 if v is not None}
+            return vals, node.lineno
+    return set(), 1
+
+
+class CliTaskRoutingRule(ProjectRule):
+    id = "REG002"
+    doc = ("the `task` values accepted by config.py's check and the "
+           "branches `cli.Application.run` dispatches on must be the "
+           "same set — otherwise a task is accepted but unroutable, or "
+           "routable but rejected at config time")
+
+    def check_project(self, files: Sequence[ParsedFile],
+                      ctx: ProjectContext) -> List[Finding]:
+        config = _find_file(files, "config.py")
+        cli = _find_file(files, "cli.py")
+        if config is None or cli is None:
+            return []
+        cfg_vals, cfg_line = _task_values_from_config(config)
+        cli_vals, cli_line = _task_values_from_cli(cli)
+        if not cfg_vals or not cli_vals:
+            return []
+        findings = []
+        for task in sorted(cfg_vals - cli_vals):
+            findings.append(Finding(
+                rule=self.id, severity=self.severity, path=config.path,
+                line=cfg_line,
+                message=f"task '{task}' passes the config check but has "
+                f"no dispatch branch in cli.Application.run"))
+        for task in sorted(cli_vals - cfg_vals):
+            findings.append(Finding(
+                rule=self.id, severity=self.severity, path=cli.path,
+                line=cli_line,
+                message=f"cli.Application.run handles task '{task}' but "
+                f"config.py's task check rejects it (dead branch — add "
+                f"it to the check or drop the branch)"))
+        return findings
+
+
+def _config_members(config: ParsedFile) -> Set[str]:
+    """Names resolvable as attributes of a Config instance: registered
+    params, class-level defs, and self.<attr> assignments."""
+    members: Set[str] = {name for name, _, _ in _collect_params(config)}
+    for node in ast.walk(config.tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    members.add(sub.name)
+                elif isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    targets = sub.targets if isinstance(sub, ast.Assign) \
+                        else [sub.target]
+                    for tgt in targets:
+                        if isinstance(tgt, ast.Attribute) and \
+                                isinstance(tgt.value, ast.Name) and \
+                                tgt.value.id == "self":
+                            members.add(tgt.attr)
+                        elif isinstance(tgt, ast.Name):
+                            members.add(tgt.id)
+    return members
+
+
+class ConfigAttrRule(ProjectRule):
+    id = "REG003"
+    severity = "error"
+    doc = ("attribute access on a `cfg` / `self.config` object must "
+           "resolve to a registered parameter or a Config class member "
+           "— a typo'd param name silently reads nothing at runtime")
+
+    def check_project(self, files: Sequence[ParsedFile],
+                      ctx: ProjectContext) -> List[Finding]:
+        config = _find_file(files, "config.py")
+        if config is None:
+            return []
+        members = _config_members(config)
+        findings: List[Finding] = []
+        for parsed in files:
+            if parsed.tree is None or parsed.path == config.path:
+                continue
+            for node in ast.walk(parsed.tree):
+                if not isinstance(node, ast.Attribute):
+                    continue
+                base = node.value
+                is_cfg = isinstance(base, ast.Name) and base.id == "cfg"
+                is_self_config = (
+                    isinstance(base, ast.Attribute) and
+                    base.attr == "config" and
+                    isinstance(base.value, ast.Name) and
+                    base.value.id == "self")
+                if not (is_cfg or is_self_config):
+                    continue
+                if node.attr.startswith("__") or node.attr in members:
+                    continue
+                findings.append(Finding(
+                    rule=self.id, severity=self.severity,
+                    path=parsed.path, line=node.lineno,
+                    message=f"'{node.attr}' is not a registered config "
+                    f"parameter or Config member (typo? register it in "
+                    f"config.py _PARAMS)"))
+        return findings
+
+
+def _known_sites(faults: ParsedFile) -> Tuple[Dict[str, int], int]:
+    sites: Dict[str, int] = {}
+    line = 1
+    for node in ast.walk(faults.tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "KNOWN_SITES"
+                for t in node.targets):
+            line = node.lineno
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                for elt in node.value.elts:
+                    v = _str_const(elt)
+                    if v is not None:
+                        sites[v] = elt.lineno
+    return sites, line
+
+
+class FaultSiteRegistryRule(ProjectRule):
+    id = "REG004"
+    doc = ("every site in reliability/faults.py KNOWN_SITES must be "
+           "wired to an injection point in the package, documented in "
+           "docs/Reliability.md, and exercised by tests/; every literal "
+           "passed to .inject() must be a known site")
+
+    def check_project(self, files: Sequence[ParsedFile],
+                      ctx: ProjectContext) -> List[Finding]:
+        faults = _find_file(files, "faults.py")
+        if faults is None:
+            return []
+        sites, decl_line = _known_sites(faults)
+        if not sites:
+            return []
+        findings: List[Finding] = []
+        # literals used as sites anywhere in the package except faults.py
+        wired: Set[str] = set()
+        for parsed in files:
+            if parsed.tree is None or parsed.path == faults.path:
+                continue
+            for node in ast.walk(parsed.tree):
+                v = _str_const(node)
+                if v in sites:
+                    wired.add(v)
+                # literal .inject("...") args must be known sites
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "inject" and node.args:
+                    arg = _str_const(node.args[0])
+                    if arg is not None and arg not in sites and \
+                            not arg.startswith("env:"):
+                        findings.append(Finding(
+                            rule=self.id, severity=self.severity,
+                            path=parsed.path, line=node.lineno,
+                            message=f"inject site '{arg}' is not in "
+                            f"KNOWN_SITES (reliability/faults.py) — "
+                            f"register it or fix the name"))
+        doc = ctx.read_doc("Reliability.md") or ""
+        tests = ctx.read_tests()
+        for site, line in sorted(sites.items()):
+            if site not in wired:
+                findings.append(Finding(
+                    rule=self.id, severity=self.severity,
+                    path=faults.path, line=line,
+                    message=f"known site '{site}' has no injection "
+                    f"point wired anywhere in the package"))
+            if site not in doc:
+                findings.append(Finding(
+                    rule=self.id, severity=self.severity,
+                    path=faults.path, line=line,
+                    message=f"known site '{site}' is not documented in "
+                    f"docs/Reliability.md"))
+            if tests and site not in tests:
+                findings.append(Finding(
+                    rule=self.id, severity=self.severity,
+                    path=faults.path, line=line,
+                    message=f"known site '{site}' is never exercised by "
+                    f"anything under tests/"))
+        return findings
+
+
+class PrometheusDocsRule(ProjectRule):
+    id = "REG005"
+    doc = ("every Prometheus metric-family literal (lightgbm_tpu_*) "
+           "emitted by an exporter must appear in "
+           "docs/Observability.md — dashboards are built from the doc, "
+           "an undocumented family is invisible")
+
+    _FAMILY_RE = re.compile(r"^lightgbm_tpu_[a-z0-9_]+$")
+
+    def check_project(self, files: Sequence[ParsedFile],
+                      ctx: ProjectContext) -> List[Finding]:
+        doc = ctx.read_doc("Observability.md")
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, str]] = set()
+        for parsed in files:
+            if parsed.tree is None:
+                continue
+            for node in ast.walk(parsed.tree):
+                v = _str_const(node)
+                if v is None or not self._FAMILY_RE.match(v):
+                    continue
+                key = (parsed.path, v)
+                if key in seen:
+                    continue
+                seen.add(key)
+                if doc is None:
+                    findings.append(Finding(
+                        rule=self.id, severity=self.severity,
+                        path=parsed.path, line=node.lineno,
+                        message=f"metric family '{v}' emitted but "
+                        f"docs/Observability.md is missing"))
+                elif v not in doc:
+                    findings.append(Finding(
+                        rule=self.id, severity=self.severity,
+                        path=parsed.path, line=node.lineno,
+                        message=f"metric family '{v}' is not documented "
+                        f"in docs/Observability.md"))
+        return findings

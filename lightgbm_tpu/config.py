@@ -38,8 +38,11 @@ _PARAMS: List[ParamSpec] = [
     # ---- Core parameters (config.h:96-226) ----
     _p("config", str, "", ("config_file",)),
     _p("task", str, "train",
-       ("task_type",), lambda v: v in ("train", "predict", "convert_model",
-                                       "refit", "save_binary", "serve")),
+       ("task_type",),
+       # "prediction"/"test" are reference-CLI spellings of "predict"
+       # (application.cpp:85); cli.Application.run routes all three
+       lambda v: v in ("train", "predict", "prediction", "test",
+                       "convert_model", "refit", "save_binary", "serve")),
     _p("objective", str, "regression",
        ("objective_type", "app", "application", "loss")),
     _p("boosting", str, "gbdt",

@@ -25,7 +25,7 @@ from .grower import TreeArrays
 __all__ = ["renew_tree_output"]
 
 
-@functools.partial(jax.jit, static_argnames=("num_leaves",))
+@functools.partial(jax.jit, static_argnames=("pct", "num_leaves"))
 def renew_tree_output(tree: TreeArrays, row_node: jax.Array,
                       score: jax.Array, label: jax.Array,
                       weight: jax.Array, pct: float,

@@ -120,7 +120,6 @@ class Trace:
     def _append(self, name, start, duration, depth, parent, attrs):
         rec = {
             "name": name,
-            "ts": start - self._epoch,       # seconds since trace epoch
             "dur": duration,                 # seconds
             "tid": threading.get_ident(),
             "depth": depth,
@@ -130,6 +129,8 @@ class Trace:
         if attrs:
             rec["attrs"] = attrs
         with self._lock:
+            # epoch read under the lock: reset() rebinds it concurrently
+            rec["ts"] = start - self._epoch  # seconds since trace epoch
             if len(self._ring) == self._ring.maxlen:
                 self.dropped += 1
             self._ring.append(rec)

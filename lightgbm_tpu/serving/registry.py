@@ -83,7 +83,7 @@ class ModelRegistry:
                 version=(prev.version + 1) if prev else 1,
                 last_used=time.monotonic())
             self._entries[name] = entry
-            self._evict_over_capacity()
+            self._evict_over_capacity_locked()
         if not forest.supported:
             Log.warning(
                 f"serving model '{name}' on the host fallback path: "
@@ -132,8 +132,8 @@ class ModelRegistry:
             return len(self._entries)
 
     # ------------------------------------------------------------------
-    def _evict_over_capacity(self) -> None:
-        # caller holds the lock
+    def _evict_over_capacity_locked(self) -> None:
+        # `_locked` suffix: caller holds the lock (docs/StaticAnalysis.md)
         while len(self._entries) > self.max_models:
             lru = min(self._entries.values(), key=lambda e: e.last_used)
             del self._entries[lru.name]

@@ -157,8 +157,15 @@ class Server:
             return out
         with global_timer.timeit("serve_bin_rows"):
             bins = entry.forest.bin_rows(X)
+        with self._lock:
+            batcher = self._batchers.get(name)
+        if batcher is None:
+            # model evicted between registry.get and here: the entry is
+            # still alive in our hands, serve it on the host path
+            self._host_resolve(entry, X, raw_score, t0, out)
+            return out
         try:
-            raw_future = self._batchers[name].submit(bins)
+            raw_future = batcher.submit(bins)
         except OverloadError:
             entry.metrics.record_shed()
             raise
@@ -239,7 +246,8 @@ class Server:
 
     # test/ops hook: the model's queue (pause/resume/queue_depth)
     def batcher(self, name: str) -> MicroBatcher:
-        return self._batchers[name]
+        with self._lock:
+            return self._batchers[name]
 
     # ------------------------------------------------------------------
     # metrics
@@ -312,4 +320,5 @@ class Server:
                     host=host, port=port)
                 Log.info("serving metrics at %s",
                          self._metrics_server.url)
-        return self._metrics_server
+            srv = self._metrics_server
+        return srv

@@ -1,0 +1,12 @@
+"""Dispatch entry points stripped of their fault sites (parsed, never
+executed) — FAULT001 must flag each manifest row it can resolve."""
+
+
+def train_many(trees):
+    # FAULT001: fused dispatch without the fused_dispatch site
+    return list(trees)
+
+
+def _grow(node):
+    # FAULT001 twice: histogram_build and collective_psum both missing
+    return node

@@ -1,0 +1,41 @@
+"""Look-alike patterns that are exempt by design — the analyzer must
+report ZERO findings here. Each block mirrors a real idiom from the
+package that a naive checker would false-positive on."""
+import functools
+import threading
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("num_outputs",))
+def structural_dispatch(x, efb=None, row_valid=None, num_outputs=1):
+    # `is None` branches select between pytrees: changing them retraces
+    # anyway, so they are structural, not recompile hazards
+    if efb is not None:
+        x = x + efb
+    if row_valid is not None:
+        x = jnp.where(row_valid, x, 0.0)
+    n = x.shape[0]               # .shape is static at trace time
+    for i in range(x.ndim):      # range over a static attribute
+        x = x + i
+    return x * num_outputs + n
+
+
+class CleanState:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._worker = object()  # assigned only here: read-only after init
+        self._jobs = []
+
+    def push(self, item):
+        with self._lock:
+            self._jobs.append(item)
+
+    def worker(self):
+        return self._worker      # init-only attr needs no lock
+
+    def _swap_locked(self):
+        # `_locked` suffix: the caller holds the lock by contract
+        jobs, self._jobs = self._jobs, []
+        return jobs

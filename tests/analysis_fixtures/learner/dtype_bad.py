@@ -1,0 +1,14 @@
+"""Known-bad dtype-discipline fixtures (parsed, never executed)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def f64_in_device_code(x):
+    acc = jnp.zeros(8, dtype=jnp.float64)   # DTYPE001: f64 accumulator
+    y = x.astype("float64")                 # DTYPE001: f64 string dtype
+    z = np.float64(0.0)                     # DTYPE001: np.float64
+    w = x.astype(float)                     # DTYPE002: implicit promotion
+    v = jnp.asarray(x, dtype=float)         # DTYPE002: dtype=float kwarg
+    return acc, y, z, w, v

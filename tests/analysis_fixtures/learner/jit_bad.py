@@ -1,0 +1,33 @@
+"""Known-bad jit-hygiene fixtures. Never imported or executed — parsed
+by tests/test_static_analysis.py, which pins the rule ids and line
+numbers each marked line must fire."""
+import functools
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def scalar_leak(x, lr: float):
+    # JIT001 on the def: `lr` is a bare-scalar-annotated param not in
+    # static_argnames — every new value recompiles
+    return x * lr
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def control_flow(x, n: int, depth=4):
+    # JIT001 on the def: `depth` has a Python-scalar default
+    if depth > 2:                # JIT002: Python branch on a traced value
+        x = x + 1.0
+    for _ in range(depth):       # JIT002: range() over a traced value
+        x = x * 2.0
+    return x * n
+
+
+@jax.jit
+def host_sync(x):
+    total = float(x.sum())       # JIT003: float() forces a host sync
+    arr = np.asarray(x)          # JIT003: numpy call on a traced value
+    flag = bool(x[0])            # JIT003: bool() forces a host sync
+    val = x.max().item()         # JIT003: .item() forces a host sync
+    return total, arr, flag, val

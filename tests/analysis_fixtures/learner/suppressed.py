@@ -1,0 +1,23 @@
+"""Violations silenced by inline suppressions — each must be reported
+with suppressed=True and not count against the exit status."""
+import threading
+
+import jax
+
+
+@jax.jit
+def quiet_sync(x):
+    return float(x.sum())  # tpulint: disable=JIT003
+
+
+class QuietState:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def peek(self):
+        return self._n  # tpulint: disable=LOCK001

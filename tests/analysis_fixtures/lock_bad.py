@@ -1,0 +1,23 @@
+"""Known-bad lock-discipline fixture (parsed, never executed)."""
+import threading
+
+
+class SharedState:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+        self._count = 0
+
+    def put(self, key, value):
+        with self._lock:
+            self._items[key] = value
+            self._count += 1
+
+    def peek(self, key):
+        return self._items.get(key)   # LOCK001: read outside the lock
+
+    def reset(self):
+        self._count = 0               # LOCK001: write outside the lock
+
+    def _drain_locked(self):
+        return list(self._items)      # clean: `_locked` caller-holds contract

@@ -1,0 +1,35 @@
+"""Lock-order cycle fixture: Alpha calls into Beta's lock while holding
+its own, and Beta does the reverse — LOCK002 must reject the cycle."""
+import threading
+
+
+class Alpha:
+    def __init__(self, beta):
+        self._lock = threading.Lock()
+        self._beta = beta
+        self._state = 0
+
+    def poke_beta(self):
+        with self._lock:
+            self._state += 1
+            self._beta.absorb_alpha()   # holds Alpha._lock -> Beta._lock
+
+    def absorb_beta(self):
+        with self._lock:
+            self._state += 1
+
+
+class Beta:
+    def __init__(self, alpha):
+        self._lock = threading.Lock()
+        self._alpha = alpha
+        self._state = 0
+
+    def absorb_alpha(self):
+        with self._lock:
+            self._state += 1
+
+    def poke_alpha(self):
+        with self._lock:
+            self._state += 1
+            self._alpha.absorb_beta()   # holds Beta._lock -> Alpha._lock

@@ -1,0 +1,22 @@
+"""Miniature drifted CLI (parsed, never executed)."""
+
+SITE = "site_a"                              # wires site_a for REG004
+OK_FAMILY = "lightgbm_tpu_documented_family"
+BAD_FAMILY = "lightgbm_tpu_rogue_family"     # REG005: not in the doc
+
+
+class Application:
+    def __init__(self, cfg):
+        self.config = cfg
+
+    def run(self):
+        task = self.config.task
+        if task == "train":
+            self.train()
+        elif task == "fit":                  # REG002: config rejects "fit"
+            self.train()
+
+    def train(self):
+        cfg = self.config
+        faults.inject("site_zzz")            # REG004: unknown site  # noqa: F821
+        return cfg.alpha + cfg.not_a_param   # REG003: unregistered attr

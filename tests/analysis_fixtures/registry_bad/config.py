@@ -1,0 +1,20 @@
+"""Miniature drifted config registry (parsed, never executed). The
+sibling docs/ dir at tests/analysis_fixtures/docs/ carries the
+deliberately stale mirrors the REG rules must flag."""
+
+
+def _p(name, type_, default, aliases=(), check=None):
+    return (name, type_, default, tuple(aliases), check)
+
+
+_PARAMS = [
+    _p("task", str, "train", ("task_type",),
+       lambda v: v in ("train", "predict")),
+    _p("alpha", float, 0.5, ("alias_one",)),   # REG001: no doc row
+    _p("beta", float, 0.5, ("alpha",)),        # REG001: alias hits a param name
+]
+
+
+class Config:
+    def __init__(self, params=None):
+        self.raw_params = {}

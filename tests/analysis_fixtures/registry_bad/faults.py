@@ -1,0 +1,6 @@
+"""Miniature fault-site registry (parsed, never executed)."""
+
+KNOWN_SITES = (
+    "site_a",   # wired (cli.py) + documented (docs/Reliability.md)
+    "site_b",   # REG004 twice: unwired and undocumented
+)

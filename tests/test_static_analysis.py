@@ -1,0 +1,179 @@
+"""tpulint (lightgbm_tpu.analysis) tier-1 tests.
+
+Two halves: (1) the package itself must be clean — zero unsuppressed
+findings, the contract that makes the analyzer a guard for every later
+PR; (2) fixture files under tests/analysis_fixtures/ prove each rule
+fires on a known-bad example at the exact line, that inline
+suppressions downgrade without hiding, and that exempt look-alike
+idioms stay silent.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import lightgbm_tpu
+from lightgbm_tpu.analysis import Analyzer, all_rules
+
+pytestmark = pytest.mark.lint
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "analysis_fixtures")
+PACKAGE_DIR = os.path.dirname(os.path.abspath(lightgbm_tpu.__file__))
+
+ALL_RULE_IDS = (
+    "DTYPE001", "DTYPE002", "FAULT001", "JIT001", "JIT002", "JIT003",
+    "LOCK001", "LOCK002", "REG001", "REG002", "REG003", "REG004",
+    "REG005",
+)
+
+
+def run_on(*relpaths):
+    paths = [os.path.join(FIXTURES, p) for p in relpaths]
+    return Analyzer().run(paths)
+
+
+def hits(findings):
+    """(rule, line) pairs, suppressed included."""
+    return {(f.rule, f.line) for f in findings}
+
+
+# ----------------------------------------------------------------------
+# the tier-1 gate: the package is clean
+def test_package_has_zero_unsuppressed_findings():
+    findings = Analyzer().run([PACKAGE_DIR])
+    active = [f for f in findings if not f.suppressed]
+    assert not active, "tpulint violations:\n" + "\n".join(
+        f.render() for f in active)
+
+
+def test_rule_catalogue_complete():
+    assert tuple(r.id for r in all_rules()) == ALL_RULE_IDS
+    for rule in all_rules():
+        assert rule.doc, f"rule {rule.id} has no doc string"
+        assert rule.severity in ("error", "warning")
+
+
+# ----------------------------------------------------------------------
+# each rule fires on its known-bad fixture at the exact line
+def test_jit_rules_fire():
+    findings = run_on("learner/jit_bad.py")
+    assert hits(findings) == {
+        ("JIT001", 11),   # scalar_leak: lr annotated scalar, not static
+        ("JIT001", 18),   # control_flow: depth scalar default
+        ("JIT002", 20),   # if depth > 2
+        ("JIT002", 22),   # for _ in range(depth)
+        ("JIT003", 29),   # float(x.sum())
+        ("JIT003", 30),   # np.asarray(x)
+        ("JIT003", 31),   # bool(x[0])
+        ("JIT003", 32),   # x.max().item()
+    }
+
+
+def test_dtype_rules_fire():
+    findings = run_on("learner/dtype_bad.py")
+    assert hits(findings) == {
+        ("DTYPE001", 9),    # jnp.float64 accumulator
+        ("DTYPE001", 10),   # astype("float64")
+        ("DTYPE001", 11),   # np.float64
+        ("DTYPE002", 12),   # astype(float)
+        ("DTYPE002", 13),   # dtype=float kwarg
+    }
+
+
+def test_lock_discipline_fires():
+    findings = run_on("lock_bad.py")
+    assert hits(findings) == {
+        ("LOCK001", 17),    # peek: self._items read outside the lock
+        ("LOCK001", 20),    # reset: self._count write outside the lock
+    }
+    # the `_locked` caller-holds contract stays silent
+    assert not any("_drain_locked" in f.message for f in findings)
+
+
+def test_lock_order_cycle_fires():
+    findings = run_on("lock_cycle_bad.py")
+    lock2 = [f for f in findings if f.rule == "LOCK002"]
+    assert len(lock2) == 1
+    assert "Alpha" in lock2[0].message and "Beta" in lock2[0].message
+
+
+def test_suppression_reports_but_does_not_count():
+    findings = run_on("learner/suppressed.py")
+    assert hits(findings) == {("JIT003", 10), ("LOCK001", 23)}
+    assert all(f.suppressed for f in findings)
+    assert not [f for f in findings if not f.suppressed]
+
+
+def test_clean_fixture_is_silent():
+    # is-None structural branches, .shape/.ndim statics, init-only
+    # attrs and the _locked convention must not false-positive
+    assert run_on("learner/clean.py") == []
+
+
+def test_registry_rules_fire():
+    findings = run_on("registry_bad")
+    got = {(f.rule, os.path.basename(f.path), f.line) for f in findings}
+    assert got == {
+        ("REG001", "config.py", 1),    # stale doc row 'gamma'
+        ("REG001", "config.py", 1),    # wrong total (same anchor line)
+        ("REG001", "config.py", 11),   # task alias drift
+        ("REG001", "config.py", 13),   # 'alpha' missing doc row
+        ("REG001", "config.py", 14),   # alias collides with param name
+        ("REG002", "config.py", 11),   # 'predict' unroutable
+        ("REG002", "cli.py", 12),      # 'fit' dead branch
+        ("REG003", "cli.py", 22),      # cfg.not_a_param
+        ("REG004", "cli.py", 21),      # inject('site_zzz') unknown
+        ("REG004", "faults.py", 5),    # site_b unwired + undocumented
+        ("REG005", "cli.py", 5),       # rogue metric family
+    }
+    # site_b produces two distinct REG004 findings on the same line
+    site_b = [f for f in findings
+              if f.rule == "REG004" and "site_b" in f.message]
+    assert len(site_b) == 2
+
+
+def test_fault_coverage_rule_fires():
+    findings = run_on("fault_bad")
+    assert all(f.rule == "FAULT001" for f in findings)
+    sites = {m for f in findings for m in
+             ("fused_dispatch", "histogram_build", "collective_psum")
+             if m in f.message}
+    assert sites == {"fused_dispatch", "histogram_build",
+                     "collective_psum"}
+    assert len(findings) == 3
+
+
+# ----------------------------------------------------------------------
+# CLI contract: module entry point, exit codes, JSON schema
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu.analysis", *args],
+        capture_output=True, text=True, timeout=120)
+
+
+def test_cli_exit_codes_and_json():
+    bad = _run_cli(os.path.join(FIXTURES, "lock_bad.py"),
+                   "--format=json")
+    assert bad.returncode == 1
+    payload = json.loads(bad.stdout)
+    assert payload["unsuppressed"] == 2
+    assert payload["suppressed"] == 0
+    assert {f["rule"] for f in payload["findings"]} == {"LOCK001"}
+    for f in payload["findings"]:
+        assert set(f) == {"rule", "severity", "path", "line", "message",
+                          "suppressed"}
+
+    clean = _run_cli(os.path.join(FIXTURES, "learner", "clean.py"))
+    assert clean.returncode == 0
+    assert "0 finding(s)" in clean.stdout
+
+
+def test_cli_list_rules():
+    res = _run_cli("--list-rules")
+    assert res.returncode == 0
+    for rule_id in ALL_RULE_IDS:
+        assert rule_id in res.stdout
