@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-all faults observe lint bench install
+.PHONY: test test-slow test-all faults observe lint pipeline bench install
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -18,6 +18,14 @@ test:
 lint:
 	$(PY) -m lightgbm_tpu.analysis lightgbm_tpu --format=json
 	$(PY) -m pytest tests/test_static_analysis.py -x -q -m lint
+
+# the pipelined-executor tier: byte-parity vs the serial block loop,
+# device-eval fidelity, adaptive scheduler (tests/test_pipeline.py,
+# docs/Performance.md) — fast subset by default; `-m pipeline` without
+# the `not slow` filter adds the interpret-mode matrix
+pipeline:
+	$(PY) -m pytest tests/ -x -q -m "pipeline and not slow"
+	$(PY) -m pytest tests/ -x -q -m "pipeline and slow"
 
 # the fault-injection tier: every registered reliability site fired and
 # recovered (tests/test_reliability.py, docs/Reliability.md)

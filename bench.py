@@ -211,6 +211,45 @@ class _Bench:
         return None, False
 
 
+def _pipeline_bench(bench, result):
+    """Pipelined-executor record (pipeline/executor.py): train extra
+    trees on the already-compiled bench booster through run_pipelined
+    (no valid sets — the overlap under measurement is stacked-tree
+    unpacking against the next block's device compute) and merge the
+    overlap fraction plus per-block host/device wall columns into the
+    JSON record. Keys MERGE like _serve_bench; best-effort: a pipeline
+    fault leaves the zeroed schema keys in place. BENCH_PIPELINE_TREES=0
+    skips (the training headline is unaffected)."""
+    n_trees = int(os.environ.get("BENCH_PIPELINE_TREES", 2 * BLOCK_TREES))
+    if n_trees <= 0 or bench is None or bench.booster is None or bench.dead:
+        return
+    try:
+        from lightgbm_tpu.pipeline import run_pipelined
+        bst = bench.booster
+        start = int(bst.current_iteration())
+        run_pipelined(bst, start_iter=start,
+                      num_boost_round=start + n_trees,
+                      base_block=min(BLOCK_TREES, n_trees),
+                      run_callbacks=lambda i, ev: None, has_valid=False)
+        _drain(bst)
+        st = getattr(bst.gbdt, "_pipeline_stats", None)
+        if st is None or not st.blocks:
+            return
+        d = st.as_dict()
+        result["pipeline_overlap_frac"] = d["overlap_frac"]
+        result["pipeline_blocks"] = d["blocks"]
+        result["pipeline_block_host_ms"] = d["host_ms"]
+        result["pipeline_block_device_ms"] = d["device_ms"]
+        print(f"# pipeline detail: {d['blocks']} blocks / "
+              f"{d['iterations']} trees, sizes {d['block_sizes']}, "
+              f"host ms {d['host_ms']}, device ms {d['device_ms']}, "
+              f"overlap {100.0 * d['overlap_frac']:.1f}%",
+              file=sys.stderr)
+    except Exception as exc:
+        print(f"# pipeline bench failed: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
+
+
 def _serve_bench(bench, result):
     """Serve-path record: a mixed-size request stream (1..1000 rows)
     through serving.Server on the just-trained booster. Keys MERGE into
@@ -307,6 +346,11 @@ def main():
               "serve_p50_ms": 0.0, "serve_p95_ms": 0.0,
               "serve_p99_ms": 0.0, "serve_buckets_compiled": 0,
               "serve_bucket_hits": 0,
+              # pipelined-executor schema (filled by _pipeline_bench;
+              # zeros when the pipeline bench is skipped or faults)
+              "pipeline_overlap_frac": 0.0, "pipeline_blocks": 0,
+              "pipeline_block_host_ms": [],
+              "pipeline_block_device_ms": [],
               # reliability-counter schema (overwritten from the live
               # counters at the end of the run)
               "device_retries": 0, "fallbacks": 0, "guard_trips": 0,
@@ -331,6 +375,8 @@ def main():
                   "possible", file=sys.stderr)
             return result, block_times, block_trees, None
         import lightgbm_tpu as lgb
+        from lightgbm_tpu import cext
+        cext.available()  # lazy g++ build happens here, not in bin_time
         X, y = make_higgs_like(N_ROWS, N_FEATURES)
         bench = _Bench(lgb, X, y)
         bench.rebuild()
@@ -391,6 +437,7 @@ def main():
         except Exception as exc:
             print(f"# device-utilization accounting failed: {exc}",
                   file=sys.stderr)
+    _pipeline_bench(bench, result)
     _serve_bench(bench, result)
     _task_bench(result)
     try:

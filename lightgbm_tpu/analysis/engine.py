@@ -212,7 +212,8 @@ def all_rules() -> List[Rule]:
     """The registered rule set, id-ordered."""
     from .rules_faults import FaultCoverageRule
     from .rules_jit import (DtypeF64Rule, DtypePromotionRule,
-                            JitHostSyncRule, JitPythonControlFlowRule,
+                            JitDonationReuseRule, JitHostSyncRule,
+                            JitPythonControlFlowRule,
                             JitStaticScalarRule)
     from .rules_lock import LockDisciplineRule, LockOrderRule
     from .rules_registry import (CliTaskRoutingRule, ConfigAttrRule,
@@ -220,7 +221,8 @@ def all_rules() -> List[Rule]:
                                  PrometheusDocsRule)
     rules: List[Rule] = [
         JitStaticScalarRule(), JitPythonControlFlowRule(),
-        JitHostSyncRule(), DtypeF64Rule(), DtypePromotionRule(),
+        JitHostSyncRule(), JitDonationReuseRule(),
+        DtypeF64Rule(), DtypePromotionRule(),
         LockDisciplineRule(), LockOrderRule(),
         ParamDocsRule(), CliTaskRoutingRule(), ConfigAttrRule(),
         FaultSiteRegistryRule(), PrometheusDocsRule(),

@@ -26,7 +26,7 @@ __all__ = ["FaultCoverageRule", "DISPATCH_MANIFEST", "SITE_WRAPPERS"]
 
 #: (file basename, function/method name, required fault site)
 DISPATCH_MANIFEST = (
-    ("gbdt.py", "train_many", "fused_dispatch"),
+    ("gbdt.py", "train_many_dispatch", "fused_dispatch"),
     ("gbdt.py", "_grow", "histogram_build"),
     ("gbdt.py", "_grow", "collective_psum"),
     ("engine.py", "predict_raw", "serving_device_predict"),
@@ -44,7 +44,7 @@ SITE_WRAPPERS = {
 _DIR_HINTS = {
     ("engine.py", "predict_raw"): "serving",
     ("checkpoint.py", "save_checkpoint"): "reliability",
-    ("gbdt.py", "train_many"): "boosting",
+    ("gbdt.py", "train_many_dispatch"): "boosting",
     ("gbdt.py", "_grow"): "boosting",
 }
 

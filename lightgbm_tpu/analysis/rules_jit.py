@@ -29,8 +29,8 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 from .engine import Finding, ParsedFile, Rule
 
 __all__ = ["JitStaticScalarRule", "JitPythonControlFlowRule",
-           "JitHostSyncRule", "DtypeF64Rule", "DtypePromotionRule",
-           "iter_jitted_functions"]
+           "JitHostSyncRule", "JitDonationReuseRule", "DtypeF64Rule",
+           "DtypePromotionRule", "iter_jitted_functions"]
 
 #: attribute reads that are static at trace time
 _STATIC_ATTRS = ("shape", "ndim", "dtype", "size")
@@ -81,6 +81,37 @@ def _static_names_from_call(call: ast.Call) -> Set[str]:
                         isinstance(node.value, str):
                     names.add(node.value)
     return names
+
+
+def _donated_names_from_call(call: ast.Call) -> Set[str]:
+    """Parameter names listed in a donate_argnames=... keyword
+    (mirrors _static_names_from_call; donate_argnums is index-form and
+    has no name to resolve here)."""
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnames":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and \
+                        isinstance(node.value, str):
+                    names.add(node.value)
+    return names
+
+
+def _donated_from_jit_expr(expr: ast.expr) -> Set[str]:
+    """Donated parameter names when `expr` is a jit/pjit wrapping call
+    (`jax.jit(fn, donate_argnames=...)` or the
+    `functools.partial(jax.jit, donate_argnames=...)` decorator form),
+    else empty."""
+    if not isinstance(expr, ast.Call):
+        return set()
+    fn = _dotted_name(expr.func)
+    if fn and fn.split(".")[-1] == "partial" and expr.args:
+        inner = _dotted_name(expr.args[0])
+        if inner and inner.split(".")[-1] in ("jit", "pjit"):
+            return _donated_names_from_call(expr)
+    if fn and fn.split(".")[-1] in ("jit", "pjit"):
+        return _donated_names_from_call(expr)
+    return set()
 
 
 def iter_jitted_functions(tree: ast.AST):
@@ -280,6 +311,131 @@ class JitHostSyncRule(Rule):
             if base in _HOST_MODULES:
                 return f"{base}.{fn.attr}()"
         return None
+
+
+class JitDonationReuseRule(Rule):
+    id = "JIT004"
+    doc = ("a Python name is read again after being passed as a donated "
+           "argument (donate_argnames) to a jitted call — the donated "
+           "buffer is deleted on non-CPU backends, so any later use of "
+           "that name dies at runtime; rebind the name from the call's "
+           "result before reading it")
+
+    # Scope, by design: only call sites whose callee resolves IN THE
+    # SAME FILE to a jit wrapping that lists donate_argnames (decorated
+    # def, or `name = jax.jit(fn, donate_argnames=...)` assignment), and
+    # only donated arguments passed as bare names. Attribute-form args
+    # (self.train_score) are deliberately not tracked — attribute
+    # rebinding is object-ownership territory the name-flow analysis
+    # cannot see, and flagging them would drown the rule in noise.
+    # Ordering is textual (line order), so a loop back-edge reuse is out
+    # of reach; the `name = jitted(name, ...)` rebind idiom is clean.
+
+    def check(self, parsed: ParsedFile) -> List[Finding]:
+        if parsed.tree is None or not parsed.in_device_dir():
+            return []
+        defs = {n.name: n for n in ast.walk(parsed.tree)
+                if isinstance(n, ast.FunctionDef)}
+        # callable name -> (donated param names, signature def or None)
+        registry: Dict[str, Tuple[Set[str],
+                                  Optional[ast.FunctionDef]]] = {}
+        for node in ast.walk(parsed.tree):
+            if isinstance(node, ast.FunctionDef):
+                for dec in node.decorator_list:
+                    donated = _donated_from_jit_expr(dec)
+                    if donated:
+                        registry[node.name] = (donated, node)
+                        break
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                donated = _donated_from_jit_expr(node.value)
+                if donated:
+                    target = None
+                    if node.value.args and \
+                            isinstance(node.value.args[0], ast.Name):
+                        target = defs.get(node.value.args[0].id)
+                    registry[node.targets[0].id] = (donated, target)
+        if not registry:
+            return []
+        findings: List[Finding] = []
+        scopes = [parsed.tree] + [n for n in ast.walk(parsed.tree)
+                                  if isinstance(n, ast.FunctionDef)]
+        for scope in scopes:
+            findings.extend(self._check_scope(parsed, scope, registry))
+        return findings
+
+    def _check_scope(self, parsed: ParsedFile, scope: ast.AST,
+                     registry) -> List[Finding]:
+        nodes = self._scope_nodes(scope)
+        calls = [n for n in nodes if isinstance(n, ast.Call) and
+                 isinstance(n.func, ast.Name) and n.func.id in registry]
+        if not calls:
+            return []
+        names = [n for n in nodes if isinstance(n, ast.Name)]
+        stmts = [n for n in nodes
+                 if isinstance(n, (ast.Assign, ast.AnnAssign,
+                                   ast.AugAssign, ast.NamedExpr))]
+        findings: List[Finding] = []
+        for call in calls:
+            donated, sig = registry[call.func.id]
+            exprs = [kw.value for kw in call.keywords
+                     if kw.arg in donated]
+            if sig is not None:
+                params = [a.arg for a in _param_names(sig)]
+                for idx, arg in enumerate(call.args):
+                    if isinstance(arg, ast.Starred):
+                        break
+                    if idx < len(params) and params[idx] in donated:
+                        exprs.append(arg)
+            tracked = {e.id for e in exprs if isinstance(e, ast.Name)}
+            end = (getattr(call, "end_lineno", None) or call.lineno,
+                   getattr(call, "end_col_offset", None) or 0)
+            for var in sorted(tracked):
+                if self._rebound_by_call_stmt(stmts, call, var):
+                    continue
+                events = sorted(
+                    (n for n in names if n.id == var and
+                     (n.lineno, n.col_offset) > end),
+                    key=lambda n: (n.lineno, n.col_offset))
+                for n in events:
+                    if isinstance(n.ctx, (ast.Store, ast.Del)):
+                        break
+                    findings.append(self.finding(
+                        parsed, n.lineno,
+                        f"'{var}' read after being donated to jitted "
+                        f"call '{call.func.id}' (buffer deleted on "
+                        f"device; rebind from the call's result first)"))
+        return findings
+
+    @staticmethod
+    def _scope_nodes(scope: ast.AST) -> List[ast.AST]:
+        """Nodes belonging to `scope` directly: nested function/class
+        bodies form their own scopes and are skipped."""
+        out: List[ast.AST] = []
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+                continue
+            out.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        return out
+
+    @staticmethod
+    def _rebound_by_call_stmt(stmts, call: ast.Call, var: str) -> bool:
+        """True when the statement holding `call` assigns `var` itself —
+        the `score = advance(score, ...)` rebind idiom."""
+        for st in stmts:
+            if not any(n is call for n in ast.walk(st)):
+                continue
+            targets = st.targets if isinstance(st, ast.Assign) \
+                else [st.target]
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name) and n.id == var:
+                        return True
+        return False
 
 
 class DtypeF64Rule(Rule):

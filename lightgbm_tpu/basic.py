@@ -618,6 +618,20 @@ class Booster:
         self._model = None
         return self.gbdt.train_many(num_iterations)
 
+    def update_batch_dispatch(self, num_iterations: int) -> dict:
+        """update_batch split at the tree-unpack boundary: run the block
+        (scores/RNG/valid trajectories fully advanced) and return a
+        handle whose finalize_block call appends the trees. The
+        pipelined executor (pipeline/executor.py) defers finalize into
+        the next block's device window; update_batch == finalize_block(
+        update_batch_dispatch(n)) exactly."""
+        self._model = None
+        return self.gbdt.train_many_dispatch(num_iterations)
+
+    def finalize_block(self, handle: dict) -> bool:
+        self._model = None
+        return self.gbdt.finalize_block(handle)
+
     def rollback_one_iter(self) -> "Booster":
         self._model = None
         self.gbdt.rollback_one_iter()

@@ -318,30 +318,50 @@ class BinMapper:
 
     def values_to_bins(self, values: np.ndarray) -> np.ndarray:
         """Vectorized value->bin (reference bin.h:149 ValueToBin)."""
-        values = np.asarray(values, dtype=np.float64)
+        vals = np.asarray(values, dtype=np.float64)
         if self.is_categorical:
-            out = np.zeros(len(values), dtype=np.int32)
-            nan_mask = ~np.isfinite(values)
-            ints = np.where(nan_mask, -1, values).astype(np.int64)
-            # map via dict (host path; small cardinality)
-            lut = self.categorical_2_bin
-            out = np.array([lut.get(int(v), 0) for v in ints], dtype=np.int32)
-            return out
-        bounds = self.bin_upper_bound
+            return self._cat_bins_from_f64(vals)
+        out = self._numeric_bins_from_f64(vals, own=vals is not values)
+        return out.astype(np.int32, copy=False)
+
+    def _cat_bins_from_f64(self, vals: np.ndarray) -> np.ndarray:
+        """Categorical value->bin over a float64 vector: sorted-key LUT
+        (searchsorted + equality mask) instead of a per-value dict loop;
+        unseen/negative/non-finite all land in dummy bin 0."""
+        ints = np.where(~np.isfinite(vals), -1, vals).astype(np.int64)
+        items = sorted(self.categorical_2_bin.items())
+        keys = np.asarray([k for k, _ in items], dtype=np.int64)
+        bins = np.asarray([b for _, b in items], dtype=np.int32)
+        if not len(keys):
+            return np.zeros(len(ints), dtype=np.int32)
+        pos = np.minimum(np.searchsorted(keys, ints), len(keys) - 1)
+        return np.where(keys[pos] == ints, bins[pos], 0).astype(np.int32)
+
+    def _numeric_bins_from_f64(self, vals: np.ndarray,
+                               own: bool = False) -> np.ndarray:
+        """Numeric value->bin over a float64 vector. `own=True` marks
+        `vals` as a scratch buffer this call may mutate in place (the
+        ZERO-missing rewrite then skips its defensive copy). NaN fixups
+        run only when NaNs are actually present, so the common all-finite
+        column pays searchsorted + one mask scan and nothing else."""
         n_numeric = self.num_bin
         has_nan_bin = self.missing_type == MissingType.NAN
         if has_nan_bin:
             n_numeric -= 1
-        search_bounds = bounds[:max(n_numeric - 1, 0)]
-        vals = values.copy()
-        if self.missing_type == MissingType.ZERO:
-            vals = np.where(np.isnan(vals), 0.0, vals)
-        out = np.searchsorted(search_bounds, vals, side="left").astype(np.int32)
+        search_bounds = self.bin_upper_bound[:max(n_numeric - 1, 0)]
+        nan_mask = np.isnan(vals)
+        has_nan = bool(nan_mask.any())
+        if has_nan and self.missing_type == MissingType.ZERO:
+            if not own:
+                vals = vals.copy()
+            vals[nan_mask] = 0.0
         # searchsorted(left) gives first bound >= v, matching "v <= bound"
-        if has_nan_bin:
-            out = np.where(np.isnan(values), self.num_bin - 1, out)
-        else:
-            out = np.where(np.isnan(values), self.default_bin, out)
+        out = np.searchsorted(search_bounds, vals, side="left")
+        if has_nan:
+            # ZERO already rewrote NaN->0.0, whose searchsorted result IS
+            # default_bin, so overwriting again is a no-op kept for parity
+            out[nan_mask] = self.num_bin - 1 if has_nan_bin \
+                else self.default_bin
         return out
 
     def bin_to_threshold_value(self, bin_idx: int) -> float:
@@ -406,24 +426,38 @@ def find_bin_mappers(X: np.ndarray, max_bin: int = 255,
     identical by construction.
     """
     from .utils.timer import global_timer
+    from . import cext
     num_data, num_features = X.shape
     cat_set = set(categorical_features or [])
+    # first cext touch may lazily g++-build the library — keep that
+    # one-time cost out of the sample timer bucket
+    has_cext = cext.available()
     with global_timer.timeit("dataset_sample"):
+        sample_t = None
         if num_data > sample_cnt:
             rng = np.random.RandomState(seed)
-            idx = rng.choice(num_data, size=sample_cnt, replace=False)
-            sample = X[np.sort(idx)]
+            idx = np.sort(rng.choice(num_data, size=sample_cnt,
+                                     replace=False))
             total = sample_cnt
+            if (has_cext and isinstance(X, np.ndarray)
+                    and X.dtype in (np.float32, np.float64)
+                    and X.flags["C_CONTIGUOUS"]):
+                # fused native gather+transpose+f64 cast: one streaming
+                # pass (lgbt_sample_transpose), bit-identical to the
+                # NumPy chain below
+                sample_t = cext.sample_transpose(X, idx)
+            else:
+                sample = X[idx]
         else:
             sample = X
             total = num_data
-        # transpose once: per-feature slices become contiguous, which
-        # makes the per-column mask/filter/sort work ~5x faster than
-        # strided views (transpose + dtype conversion fused into a
-        # single allocation)
-        sample_t = np.ascontiguousarray(np.asarray(sample).T,
-                                        dtype=np.float64)
-    from . import cext
+        if sample_t is None:
+            # transpose once: per-feature slices become contiguous, which
+            # makes the per-column mask/filter/sort work ~5x faster than
+            # strided views (transpose + dtype conversion fused into a
+            # single allocation)
+            sample_t = np.ascontiguousarray(np.asarray(sample).T,
+                                            dtype=np.float64)
     numeric = [f for f in range(num_features) if f not in cat_set]
     if cext.available() and numeric:
         # native whole-matrix boundary search (cext/binning.cpp
@@ -466,7 +500,6 @@ def bin_columns(X: np.ndarray, feat_indices: Sequence[int],
     use the vectorized NumPy path."""
     from . import cext
     num_data = X.shape[0]
-    out = np.empty((num_data, len(feat_indices)), dtype=dtype)
     numeric = [j for j, m in enumerate(mappers) if not m.is_categorical]
     if cext.available() and numeric and num_data > 10000:
         bounds, offs, nsearch, nanb = [], [0], [], []
@@ -488,13 +521,33 @@ def bin_columns(X: np.ndarray, feat_indices: Sequence[int],
             flat, np.asarray(offs[:-1], np.int64),
             np.asarray(nsearch, np.int32), np.asarray(nanb, np.int32),
             dtype)
+        if len(numeric) == len(mappers):
+            # all-numeric (the dense ingestion common case): the native
+            # output IS the bin matrix — skip the [N, F] fancy-index copy
+            return sub
+        out = np.empty((num_data, len(feat_indices)), dtype=dtype)
         out[:, numeric] = sub
         rest = [j for j, m in enumerate(mappers) if m.is_categorical]
     else:
-        rest = range(len(mappers))
-    for j in rest:
-        out[:, j] = mappers[j].values_to_bins(
-            np.asarray(X[:, feat_indices[j]], dtype=np.float64)).astype(dtype)
+        out = np.empty((num_data, len(feat_indices)), dtype=dtype)
+        rest = list(range(len(mappers)))
+    if rest:
+        # fused quantize pass: one reusable contiguous float64 scratch
+        # per column (copyto, no per-column allocation) that the mapper
+        # may mutate in place (own=True skips the ZERO-missing copy),
+        # searchsorted, then a single strided store. The working set
+        # stays one column (~8 bytes/row) so the searchsorted read hits
+        # cache; replaces the copy / np.where / int32-cast chain that
+        # dominated the quantize wall on the NumPy path. (A whole-matrix
+        # [F, N] staging pass measures SLOWER here — it streams the full
+        # matrix through memory twice and evicts every column before its
+        # bound search runs.)
+        scratch = np.empty(num_data, dtype=np.float64)
+        for j in rest:
+            m = mappers[j]
+            np.copyto(scratch, X[:, feat_indices[j]], casting="unsafe")
+            out[:, j] = m._cat_bins_from_f64(scratch) if m.is_categorical \
+                else m._numeric_bins_from_f64(scratch, own=True)
     return out
 
 

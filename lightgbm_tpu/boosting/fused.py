@@ -33,14 +33,25 @@ and early stopping between dispatches.
 from __future__ import annotations
 
 import functools
+import warnings
 
 import jax
 import jax.numpy as jnp
 
 __all__ = ["build_fused_train", "stacked_score_traj"]
 
+# Score carries are donated (jax.jit donate_argnames): XLA reuses the
+# input buffer for the output instead of allocating a fresh [N] (or
+# [N, K]) f32 per block — on TPU the f32 score cache is the largest
+# recurring training allocation. The CPU backend cannot honor donation
+# and warns on every dispatch; that warning is noise for this
+# by-design-portable code path, so it is silenced here and ONLY here.
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
 
-@functools.partial(jax.jit, static_argnames=("num_class",))
+
+@functools.partial(jax.jit, static_argnames=("num_class",),
+                   donate_argnames=("score0",))
 def stacked_score_traj(stacked, score0, bins, num_bins, missing_is_nan,
                        *, num_class: int = 1):
     """Per-iteration score trajectory of a stacked tree block over a
@@ -144,7 +155,13 @@ def build_fused_train(*, objective, bins, cnt_weight, feature_mask_fn,
                                lambda *xs: jnp.stack(xs), *dbgs))
         return score, stacked
 
-    @functools.partial(jax.jit, static_argnames=("k",))
+    # `score` is donated: the caller hands over its train-score buffer
+    # and must treat the passed-in array as consumed (use the returned
+    # score'). GBDT.train_many reassigns self.train_score from the
+    # result and its fault paths check .is_deleted() before reusing the
+    # old buffer — tpulint JIT004 guards the bare-name discipline.
+    @functools.partial(jax.jit, static_argnames=("k",),
+                       donate_argnames=("score",))
     def run(score, it0, *, k: int, sample_keys=None):
         its = jnp.asarray(it0, jnp.int32) + jnp.arange(k, dtype=jnp.int32)
         if sample_keys is None:

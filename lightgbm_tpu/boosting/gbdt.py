@@ -576,11 +576,18 @@ class GBDT:
         set_custom_objective)."""
         if getattr(self, "_custom_objective", False):
             return 0.0
-        return 1.0 if (
-            self.objective is not None and
-            getattr(self.objective, "is_constant_hessian", False) and
-            getattr(self.objective, "weight", None) is None and
-            self.config.boosting != "goss") else 0.0
+        if (self.objective is not None and
+                getattr(self.objective, "is_constant_hessian", False) and
+                getattr(self.objective, "weight", None) is None and
+                self.config.boosting != "goss"):
+            # the objective owns the actual constant (1.0 for the L1/L2
+            # family, but e.g. a scaled-L2 objective declares its own) —
+            # the kernels reconstruct hessian sums as const x count, so
+            # a hardcoded 1.0 here would silently mis-train any
+            # non-unit constant-hessian objective on the fast path
+            return float(getattr(self.objective,
+                                 "constant_hessian_value", 1.0))
+        return 0.0
 
     def set_custom_objective(self) -> None:
         """Mark this booster as trained (at least once) on user-supplied
@@ -1177,6 +1184,49 @@ class GBDT:
         the per-iteration path for this batch instead of propagating;
         after two consecutive fused failures the fused path is disabled
         for the rest of this booster's life."""
+        return self.finalize_block(self.train_many_dispatch(k))
+
+    def finalize_block(self, handle: dict) -> bool:
+        """Second half of train_many: unpack the dispatched block's
+        stacked trees into per-tree views on self.trees. Pure host work
+        (tree_map slicing; no device sync) whose only effect is the
+        tree list — scores, RNG, iter_, valid trajectories and the
+        stall poll were already advanced by train_many_dispatch, so the
+        pipelined executor defers this call into the window where the
+        NEXT block is running on device."""
+        if handle["mode"] == "fused":
+            stacked, kcls = handle["stacked"], handle["kcls"]
+            for i in range(handle["k"]):
+                for c in range(kcls):
+                    self.trees.append(jax.tree_util.tree_map(
+                        (lambda a: a[i, c]) if kcls > 1
+                        else (lambda a: a[i]), stacked))
+                    self.tree_class.append(c if kcls > 1 else 0)
+                    self.linear_models.append(None)
+        return handle["stop"]
+
+    @staticmethod
+    def _buffer_deleted(arr) -> bool:
+        """True when a donated jax.Array's buffer is gone (TPU donation
+        consumes the input; CPU ignores donation so this stays False)."""
+        fn = getattr(arr, "is_deleted", None)
+        try:
+            return bool(fn()) if fn is not None else False
+        except Exception:
+            return False
+
+    def train_many_dispatch(self, k: int) -> dict:
+        """First half of train_many: run the k iterations (fused
+        dispatch when eligible, else the per-iteration loop) and leave
+        everything EXCEPT the per-tree unpacking done. Returns an
+        opaque handle for finalize_block; until finalize_block runs,
+        self.trees lags self.iter_ by the fused block.
+
+        The split exists for the pipelined executor
+        (pipeline/executor.py): unpacking stacked trees into Tree
+        objects is host-only work with no effect on the next dispatch's
+        inputs, so the executor overlaps it with the next block's
+        device compute."""
         # per-iteration valid-score trajectory of this batch (engine
         # block dispatch evaluates/early-stops from it). EVERY path
         # through this method — fused, per-iteration fallback, stalled —
@@ -1210,17 +1260,17 @@ class GBDT:
                     self.train_one_iter()
                     _snap()
                 _seal()
-                return True
+                return {"mode": "done", "stop": True}
         if k <= 0:
             _seal()
-            return stop
+            return {"mode": "done", "stop": stop}
         if not self._fused_eligible() or getattr(
                 self, "_fused_disabled", False):
             for _ in range(k):
                 stop = self.train_one_iter() or stop
                 _snap()
             _seal()
-            return stop
+            return {"mode": "done", "stop": stop}
         saved_rng = self._rng_key
         cfg = self.config
 
@@ -1230,6 +1280,14 @@ class GBDT:
             # IDENTICAL key sequence — a transient fault must not
             # change the trained model
             self._rng_key = saved_rng
+            if self._buffer_deleted(self.train_score):
+                # a previous attempt donated the score buffer to a
+                # dispatch that failed after consuming it; retrying
+                # would feed XLA a dead buffer — fail with a clear
+                # diagnosis instead
+                raise LightGBMError(
+                    "train-score buffer was donated to a failed fused "
+                    "dispatch and deleted by the runtime; cannot retry")
             try:
                 _maybe_inject_fused_fault()
                 if getattr(self, "_fused_run", None) is None:
@@ -1266,6 +1324,15 @@ class GBDT:
             # the IDENTICAL key sequence the fused dispatch consumed —
             # a transient fault must not change the trained model
             self._rng_key = saved_rng
+            if self._buffer_deleted(self.train_score):
+                # donation consumed the score carry before the fault
+                # landed: the per-iteration fallback would read a dead
+                # buffer, so surface the truth instead of degrading
+                raise LightGBMError(
+                    "fused dispatch failed after its donated train-score "
+                    "buffer was consumed; per-iteration fallback is "
+                    "impossible — restart from the last checkpoint"
+                ) from exc
             self._fused_failures = getattr(self, "_fused_failures", 0) + 1
             self._fused_run = None  # closure may hold dead executables
             counters.inc("fallbacks")
@@ -1281,7 +1348,7 @@ class GBDT:
                 stop = self.train_one_iter() or stop
                 _snap()
             _seal()
-            return stop
+            return {"mode": "done", "stop": stop}
         self._fused_failures = 0
         if _orec:
             # the fused scan is lazy: force completion so the recorded
@@ -1302,23 +1369,20 @@ class GBDT:
             from .fused import stacked_score_traj
             trajs = []
             for i in range(len(self.valid_sets)):
+                # any snapped lead points alias the very buffer donated
+                # below as score0 — stack them into a fresh array FIRST
+                # (on TPU the dispatch deletes the donated input)
+                lead = jnp.stack(traj_pts[i]) \
+                    if traj_pts is not None and traj_pts[i] else None
                 fin, traj = stacked_score_traj(
                     stacked, self.valid_scores[i], self.valid_bins[i],
                     self.num_bins_d, self.missing_is_nan_d,
                     num_class=kcls)
-                if traj_pts is not None and traj_pts[i]:
-                    traj = jnp.concatenate(
-                        [jnp.stack(traj_pts[i]), traj])
+                if lead is not None:
+                    traj = jnp.concatenate([lead, traj])
                 self.valid_scores[i] = fin
                 trajs.append(traj)
             self._fused_valid_traj = trajs
-        for i in range(k):
-            for c in range(kcls):
-                self.trees.append(jax.tree_util.tree_map(
-                    (lambda a: a[i, c]) if kcls > 1 else (lambda a: a[i]),
-                    stacked))
-                self.tree_class.append(c if kcls > 1 else 0)
-                self.linear_models.append(None)
         self.iter_ += k
         # lagged stall poll (see train_one_iter): a stalled model keeps
         # producing all-zero trees, so checking the batch's last tree
@@ -1337,7 +1401,8 @@ class GBDT:
         except Exception:
             pass
         self._pending_nleaves = pending
-        return stop_hint
+        return {"mode": "fused", "stacked": stacked, "k": k,
+                "kcls": kcls, "stop": stop_hint}
 
     def _constant_tree(self, value: float) -> TreeArrays:
         m1 = 2 * self.config.num_leaves - 1 + 1

@@ -88,6 +88,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
         c_ip, ctypes.c_int,
         c_dp, ctypes.POINTER(ctypes.c_long), c_ip, c_ip,
         ctypes.c_int, ctypes.c_void_p]
+    lib.lgbt_sample_transpose.restype = None
+    lib.lgbt_sample_transpose.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_long), ctypes.c_long, c_dp]
     lib.lgbt_find_numeric_bounds.restype = ctypes.c_int
     lib.lgbt_find_numeric_bounds.argtypes = [
         c_dp, ctypes.c_int, ctypes.c_long, ctypes.c_int, ctypes.c_int,
@@ -168,6 +172,25 @@ def values_to_bins_u8(values: np.ndarray, bounds: np.ndarray,
         bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         num_search, nan_bin,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out
+
+
+def sample_transpose(X: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """Fused X[idx].T + float64 cast: one native streaming pass instead of
+    the gather / transpose / cast NumPy chain. X must be C-contiguous
+    [N, F] float32 or float64; idx sorted int64 row indices. Returns a
+    contiguous [F, len(idx)] float64 sample, bit-identical to
+    np.ascontiguousarray(X[idx].T, dtype=np.float64)."""
+    lib = get_lib()
+    assert lib is not None
+    is_f32 = 1 if X.dtype == np.float32 else 0
+    idx = np.ascontiguousarray(idx, np.int64)
+    n_rows, f_total = X.shape
+    out = np.empty((f_total, len(idx)), np.float64)
+    lib.lgbt_sample_transpose(
+        X.ctypes.data_as(ctypes.c_void_p), is_f32, f_total,
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_long)), len(idx),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
     return out
 
 

@@ -243,6 +243,13 @@ class Application:
         finally:
             if msrv is not None:
                 msrv.close()
+        stats = getattr(getattr(booster, "gbdt", None),
+                        "_pipeline_stats", None)
+        if stats is not None and stats.blocks:
+            Log.info("pipelined executor: %d blocks / %d iterations, "
+                     "%.1f%% host/device overlap",
+                     stats.blocks, stats.iterations,
+                     100.0 * stats.overlap_frac)
         booster.save_model(cfg.output_model)
         Log.info("Finished training, model saved to %s", cfg.output_model)
         if cfg.observe and cfg.observe_trace_file:

@@ -352,6 +352,42 @@ _PARAMS: List[ParamSpec] = [
        "results match per-iteration training exactly; the win is one "
        "host round-trip per block instead of per tree. 1 = dispatch "
        "per iteration (the reference's cadence, gbdt.cpp:371)"),
+    _p("pipeline", bool, True, ("pipelined_training",),
+       desc="double-buffered training executor (pipeline/executor.py) "
+            "when block dispatch is active (fused_block_size > 1 and "
+            "the run is fused-eligible): block k+1 is dispatched "
+            "asynchronously while the host unpacks block k's trees and "
+            "runs its callbacks, syncing only at early-stop decisions. "
+            "Bit-identical models to pipeline=false — the non-pipelined "
+            "block path stays available as the parity oracle"),
+    _p("pipeline_device_eval", bool, True, (),
+       desc="compute valid-set metrics in-graph over the block's score "
+            "trajectory (pipeline/device_eval.py), so early stopping "
+            "reads one [block, n_metrics] array per dispatch instead of "
+            "pulling full per-iteration score matrices to the host. "
+            "Engages only when every metric on every valid set has a "
+            "device kernel (pointwise families + multiclass "
+            "logloss/error); ranking-style metrics (auc, ndcg, map) "
+            "fall back to host evaluation for the whole run. Device "
+            "metric values are f32 while host evaluation is f64, so "
+            "logged metric VALUES may differ in the last digits; split "
+            "decisions, scores and models are unaffected"),
+    _p("pipeline_adaptive_blocks", bool, True, (),
+       desc="let the pipelined executor grow the per-dispatch block "
+            "size from the measured steady-state training rate "
+            "(pipeline/scheduler.py) instead of using fused_block_size "
+            "for every block, targeting pipeline_target_block_ms per "
+            "dispatch and never crossing an early_stopping_rounds "
+            "boundary. Block partitioning cannot change the trained "
+            "model (the fused scan is iteration-exact), only dispatch "
+            "cadence"),
+    _p("pipeline_target_block_ms", float, 250.0, (), lambda v: v > 0,
+       "steady-state device time the adaptive scheduler aims to keep "
+       "in flight per dispatch. Larger blocks amortize more host "
+       "round-trips but coarsen the early-stop sync cadence"),
+    _p("pipeline_max_block", int, 200, (), lambda v: v >= 1,
+       "upper bound on the adaptive scheduler's block size, whatever "
+       "the measured rate suggests"),
 ]
 
 _SPEC_BY_NAME: Dict[str, ParamSpec] = {p.name: p for p in _PARAMS}

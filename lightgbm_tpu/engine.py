@@ -227,6 +227,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
                   and not is_valid_contain_train
                   and getattr(booster.gbdt, "_fused_eligible",
                               lambda: False)())
+    # pipelined executor (pipeline/executor.py): same block dispatch,
+    # but host work (tree unpacking, scheduling, observability) overlaps
+    # the next block's device compute, and valid metrics can reduce
+    # in-graph. Bit-identical models either way — pipeline=false keeps
+    # this loop as the parity oracle.
+    use_pipeline = use_blocks and bool(getattr(cfg, "pipeline", False))
 
     def _eval_at(i):
         evaluation_result_list = []
@@ -244,7 +250,27 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
     evaluation_result_list = []
     try:
-        i = start_iter
+        if use_pipeline and start_iter < num_boost_round:
+            from .pipeline import run_pipelined
+
+            def _run_cbs(i, evlist):
+                for cb in callbacks_after:
+                    cb(callback_mod.CallbackEnv(
+                        model=booster, params=params, iteration=i,
+                        begin_iteration=start_iter,
+                        end_iteration=num_boost_round,
+                        evaluation_result_list=evlist))
+
+            es_rounds = int(params.get("early_stopping_round", 0) or 0)
+            evaluation_result_list = run_pipelined(
+                booster, start_iter=start_iter,
+                num_boost_round=num_boost_round, base_block=block,
+                run_callbacks=_run_cbs,
+                has_valid=bool(reduced_valid_sets),
+                stopping_rounds=es_rounds)
+            i = num_boost_round   # fully trained; the loop below no-ops
+        else:
+            i = start_iter
         while i < num_boost_round:
             b = min(block, num_boost_round - i) if use_blocks else 1
             if b > 1:

@@ -43,6 +43,12 @@ class ObjectiveFunction:
     name = "custom"
     num_model_per_iteration = 1
     is_constant_hessian = False
+    # the per-row hessian constant promised when is_constant_hessian:
+    # get_gradients must return hess == constant_hessian_value * 1 for
+    # every row (pre-weighting). Kernels reconstruct hessian sums as
+    # constant x count, so subclasses with non-unit constant hessians
+    # MUST override this alongside is_constant_hessian.
+    constant_hessian_value = 1.0
     need_renew_tree_output = False
     # multiplier LightGBM applies to averaged init score (av. leaf output)
     boost_from_average_multiplier = 1.0

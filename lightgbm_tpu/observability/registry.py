@@ -50,6 +50,10 @@ class ObservabilityRegistry:
         self.training = TrainingTelemetry()
         self.compiles = CompileAccounting()
         self.mfu = DeviceUtilization()
+        # pipelined-executor aggregates (pipeline/executor.py): how much
+        # of the block walls the overlapped host work covered
+        self._pipeline = {"blocks": 0, "iterations": 0,
+                          "host_seconds": 0.0, "wall_seconds": 0.0}
         # shared singletons, NOT copies — existing call sites in
         # serving/, reliability/ and the phase timeits keep writing to
         # the same objects this registry reads.
@@ -81,11 +85,25 @@ class ObservabilityRegistry:
         self.training.reset()
         self.compiles.reset()
         self.mfu.reset()
+        with self._lock:
+            self._pipeline = {"blocks": 0, "iterations": 0,
+                              "host_seconds": 0.0, "wall_seconds": 0.0}
 
     # -- exporters ------------------------------------------------------
+    def pipeline_snapshot(self) -> Dict:
+        with self._lock:
+            p = dict(self._pipeline)
+        frac = min(1.0, p["host_seconds"] / p["wall_seconds"]) \
+            if p["wall_seconds"] > 0 else 0.0
+        return {"blocks": p["blocks"], "iterations": p["iterations"],
+                "host_seconds": round(p["host_seconds"], 6),
+                "wall_seconds": round(p["wall_seconds"], 6),
+                "overlap_frac": round(frac, 4)}
+
     def snapshot(self) -> Dict:
         return {
             "enabled": self.enabled,
+            "pipeline": self.pipeline_snapshot(),
             "training": self.training.snapshot(),
             "compiles": {"entries": self.compiles.snapshot(),
                          **self.compiles.totals()},
@@ -108,6 +126,7 @@ class ObservabilityRegistry:
             (snap["compiles"], "lightgbm_tpu_compiles", None),
             (snap["device_utilization"], "lightgbm_tpu_device", None),
             (snap["counters"], "lightgbm_tpu_reliability", None),
+            (snap["pipeline"], "lightgbm_tpu_pipeline", None),
             (snap["timers"], "lightgbm_tpu_timer_seconds", None),
             (snap["trace"], "lightgbm_tpu_trace", None),
         ])
@@ -195,6 +214,27 @@ class ObservabilityRegistry:
             self.mfu.add(macs, wall_s, trees)
         self.trace.add("fused_block", t0, wall_s, iterations=int(k),
                        compiled=bool(was_built))
+
+    def record_pipeline_block(self, iteration: int, k: int, t0: float,
+                              wall_s: float, host_s: float,
+                              overlap_frac: float) -> None:
+        """One pipelined-executor block: wall_s spans dispatch to metric
+        sync, host_s is the overlapped host window inside it (previous
+        block's tree unpacking + scheduling). Training compute itself is
+        already recorded by record_fused_block — this layer only
+        accounts the overlap."""
+        if not self.enabled:
+            return
+        with self._lock:
+            p = self._pipeline
+            p["blocks"] += 1
+            p["iterations"] += int(k)
+            p["host_seconds"] += float(host_s)
+            p["wall_seconds"] += float(wall_s)
+        self.trace.add("pipeline_block", t0, wall_s, iteration=int(iteration),
+                       iterations=int(k),
+                       host_ms=round(float(host_s) * 1e3, 3),
+                       overlap_frac=round(float(overlap_frac), 4))
 
 
 #: process-global singleton; `lightgbm_tpu.observability.registry`.

@@ -1,0 +1,20 @@
+"""Pipelined device-resident training executor.
+
+Double-buffers the fused block dispatch (boosting/fused.py): while
+block k runs on device, the host unpacks block k-1's stacked trees into
+per-tree views, updates the adaptive block scheduler and observability,
+and only then syncs block k's per-iteration metric arrays for the
+callback/early-stop protocol. Models are bit-identical to the
+non-pipelined block loop in engine.train (the parity oracle —
+tests/test_pipeline.py); the win is that per-tree host work and device
+compute overlap instead of alternating.
+
+Engaged by engine.train when `pipeline=true` (default) and the run is
+already block-dispatch eligible; `pipeline=false` reverts to the
+non-pipelined loop unchanged.
+"""
+
+from .executor import PipelineStats, run_pipelined
+from .scheduler import AdaptiveBlockScheduler
+
+__all__ = ["AdaptiveBlockScheduler", "PipelineStats", "run_pipelined"]

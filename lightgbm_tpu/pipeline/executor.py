@@ -1,0 +1,194 @@
+"""Double-buffered training loop: overlap host work with device blocks.
+
+The non-pipelined block loop in engine.train alternates strictly:
+dispatch a fused block, sync, unpack its stacked trees, evaluate, run
+callbacks, repeat — the device idles through all host work. This
+executor reorders the same steps around JAX's async dispatch so the
+expensive host step (unpacking K stacked TreeArrays into per-tree
+views) always runs while the NEXT block is computing:
+
+    dispatch block k (async)  ──────────────┐ device busy
+    launch block k's metric reductions      │
+    finalize block k-1's trees  <── overlap │ host busy
+    scheduler / observability updates       │
+    sync block k's metrics  ────────────────┘ explicit sync point
+    callbacks j = 0..b-1 (early stop may raise)
+
+Nothing is speculative: block k+1 is never dispatched before block k's
+early-stop decisions, so the executor trains the byte-identical model
+of the non-pipelined loop — which stays available via pipeline=false as
+the parity oracle (tests/test_pipeline.py). Early stop mid-block
+replicates the engine's protocol exactly: finalize this block's trees,
+restore block-final valid scores, roll back the post-stop trees, pin
+valid scores to the stopping iteration's trajectory point, re-raise.
+
+Metric values come from device reductions when every metric supports it
+(device_eval.py) — the sync then moves a [b, n_metrics] array instead
+of full score matrices — else from the host metrics path, identically
+to the engine loop.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..callback import EarlyStopException
+from ..observability import registry as _obs
+from .device_eval import build_device_eval
+from .scheduler import AdaptiveBlockScheduler
+
+__all__ = ["PipelineStats", "run_pipelined"]
+
+
+class PipelineStats:
+    """Per-run pipeline accounting, attached to the booster's GBDT as
+    `_pipeline_stats` unconditionally (bench.py reads it with
+    observability off; registry.record_pipeline_block mirrors it into
+    the unified snapshot when observability is on)."""
+
+    def __init__(self):
+        self.blocks = 0
+        self.iterations = 0
+        self.block_sizes: List[int] = []
+        self.host_ms: List[float] = []      # overlapped host work / block
+        self.device_ms: List[float] = []    # dispatch->results wall / block
+
+    def add(self, k: int, host_ms: float, device_ms: float) -> None:
+        self.blocks += 1
+        self.iterations += int(k)
+        self.block_sizes.append(int(k))
+        self.host_ms.append(float(host_ms))
+        self.device_ms.append(float(device_ms))
+
+    @property
+    def overlap_frac(self) -> float:
+        """Fraction of total block wall covered by overlapped host
+        work — the pipelining win (0 = fully serial)."""
+        wall = sum(self.device_ms)
+        if wall <= 0:
+            return 0.0
+        return min(1.0, sum(self.host_ms) / wall)
+
+    def as_dict(self) -> dict:
+        return {
+            "blocks": self.blocks,
+            "iterations": self.iterations,
+            "block_sizes": list(self.block_sizes),
+            "host_ms": [round(v, 3) for v in self.host_ms],
+            "device_ms": [round(v, 3) for v in self.device_ms],
+            "overlap_frac": round(self.overlap_frac, 4),
+        }
+
+
+def run_pipelined(booster, *, start_iter: int, num_boost_round: int,
+                  base_block: int, run_callbacks: Callable[[int, List], None],
+                  has_valid: bool, stopping_rounds: int = 0) -> List:
+    """Train [start_iter, num_boost_round) pipelined; returns the last
+    evaluation_result_list. Raises EarlyStopException (and any callback
+    exception) with the booster in the exact state the non-pipelined
+    block loop would leave it in — engine.train's handlers run
+    unchanged."""
+    gb = booster.gbdt
+    cfg = booster.config
+    sched = AdaptiveBlockScheduler(
+        base_block, adaptive=bool(cfg.pipeline_adaptive_blocks),
+        target_ms=float(cfg.pipeline_target_block_ms),
+        max_block=int(cfg.pipeline_max_block),
+        stopping_rounds=int(stopping_rounds or 0))
+    dev = build_device_eval(booster) \
+        if has_valid and cfg.pipeline_device_eval else None
+    stats = PipelineStats()
+    gb._pipeline_stats = stats
+    pending: Optional[dict] = None
+    evlist: List = []
+    i = start_iter
+    try:
+        while i < num_boost_round:
+            b = sched.next_block(num_boost_round - i)
+            was_built = getattr(gb, "_fused_run", None) is None
+            t0 = time.perf_counter()
+            handle = booster.update_batch_dispatch(b)
+            traj = getattr(gb, "_fused_valid_traj", None)
+            mx = dev.dispatch(traj) \
+                if dev is not None and traj is not None else None
+            t1 = time.perf_counter()
+            # ---- overlapped host window: the previous block's trees
+            # unpack while this block runs on device
+            if pending is not None:
+                booster.finalize_block(pending)
+                pending = None
+            t2 = time.perf_counter()
+            # ---- explicit sync: small metric arrays in device-eval
+            # mode; in host mode the trajectory syncs lazily when the
+            # metrics first touch it below
+            mhost = [None if a is None else np.asarray(a) for a in mx] \
+                if mx is not None else None
+            t3 = time.perf_counter()
+            host_ms = (t2 - t1) * 1e3
+            block_ms = (t3 - t0) * 1e3
+            stats.add(b, host_ms, block_ms)
+            if _obs.enabled:
+                _obs.record_pipeline_block(
+                    i, b, t0, (t3 - t0), (t2 - t1),
+                    min(1.0, host_ms / block_ms) if block_ms > 0 else 0.0)
+            # ---- per-iteration metric/callback protocol (identical to
+            # the engine block loop; early stop decisions gate the next
+            # dispatch, so nothing downstream is speculative)
+            finalized = False
+            try:
+                if traj is not None and has_valid:
+                    try:
+                        for j in range(b):
+                            if mhost is not None:
+                                evlist = dev.evlist_at(mhost, j)
+                            else:
+                                for vi in range(len(traj)):
+                                    gb.valid_scores[vi] = traj[vi][j]
+                                evlist = booster.eval_valid()
+                            run_callbacks(i + j, evlist)
+                    except EarlyStopException:
+                        # this block's trees must exist before rollback
+                        # pops them; then replicate the engine's restore
+                        # protocol: block-final scores, roll the
+                        # post-stop trees back, pin valid scores to the
+                        # stopping iteration's trajectory point
+                        booster.finalize_block(handle)
+                        finalized = True
+                        for vi in range(len(traj)):
+                            gb.valid_scores[vi] = traj[vi][b - 1]
+                        for _ in range(b - 1 - j):
+                            booster.rollback_one_iter()
+                        for vi in range(len(traj)):
+                            gb.valid_scores[vi] = traj[vi][j]
+                        raise
+                elif has_valid:
+                    # belt-and-braces (mirrors engine.train): a missing
+                    # trajectory degrades to block-end eval cadence
+                    evlist = booster.eval_valid()
+                    run_callbacks(i + b - 1, evlist)
+                else:
+                    for j in range(b):
+                        evlist = []
+                        run_callbacks(i + j, evlist)
+            except BaseException:
+                # any other exit: leave the booster consistent — trees
+                # hold the full block, so scores must too
+                if not finalized:
+                    booster.finalize_block(handle)
+                    if traj is not None:
+                        for vi in range(len(traj)):
+                            gb.valid_scores[vi] = traj[vi][b - 1]
+                raise
+            # in host-eval mode the loop above left valid_scores at
+            # traj[b-1], the block-final state; device mode never moved
+            # them off it
+            pending = handle
+            i += b
+            sched.observe(b, t3 - t0, compiled=was_built)
+    finally:
+        if pending is not None:
+            booster.finalize_block(pending)
+    return evlist

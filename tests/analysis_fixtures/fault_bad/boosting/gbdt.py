@@ -2,7 +2,7 @@
 executed) — FAULT001 must flag each manifest row it can resolve."""
 
 
-def train_many(trees):
+def train_many_dispatch(trees):
     # FAULT001: fused dispatch without the fused_dispatch site
     return list(trees)
 

@@ -1,0 +1,50 @@
+"""Known-bad buffer-donation fixtures. Never imported or executed —
+parsed by tests/test_static_analysis.py, which pins the JIT004 line
+numbers; the `ok_*` functions are the exempt idioms that must stay
+silent."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnames=("score",))
+def advance(score, delta):
+    return score + delta
+
+
+def use_after_keyword_donation(score, delta):
+    out = advance(score=score, delta=delta)
+    return out + score          # JIT004: score was donated on line 16
+
+
+def _step(carry, dx):
+    return carry * dx
+
+
+step = jax.jit(_step, donate_argnames=("carry",))
+
+
+def use_after_positional_donation(carry, dx):
+    nxt = step(carry, dx)
+    total = carry + 1.0         # JIT004: carry donated positionally
+    return nxt, total
+
+
+def ok_rebind_from_result(score, delta):
+    score = advance(score=score, delta=delta)
+    return score * 2.0          # rebound from the call's result: clean
+
+
+class Holder:
+    def ok_attribute_receiver(self, delta):
+        # attribute-form donated args are deliberately not tracked —
+        # attribute rebinding is object-ownership territory the
+        # name-flow analysis cannot see
+        out = advance(score=self.buf, delta=delta)
+        return out + self.buf
+
+
+def ok_store_then_use(carry, dx):
+    nxt = step(carry, dx)
+    carry = nxt
+    return carry + 1.0          # rebound before the read: clean

@@ -1,0 +1,173 @@
+"""Fused quantize pass (binning.py bin_columns NumPy path): exact
+bin-id equality vs the original per-column searchsorted/dict-loop
+implementation, which is inlined here verbatim as the reference.
+
+The fused path changed three things — a single [F, N] float64 staging
+buffer instead of per-column strided conversions, in-place NaN fixups
+gated on NaNs actually being present, and a sorted-key LUT for
+categoricals — none of which may move a single bin id.
+"""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.binning import (BinMapper, MissingType, bin_columns,
+                                  find_bin_mappers)
+
+
+def _ref_values_to_bins(m: BinMapper, values: np.ndarray) -> np.ndarray:
+    """Verbatim copy of the pre-fusion BinMapper.values_to_bins."""
+    values = np.asarray(values, dtype=np.float64)
+    if m.is_categorical:
+        nan_mask = ~np.isfinite(values)
+        ints = np.where(nan_mask, -1, values).astype(np.int64)
+        lut = m.categorical_2_bin
+        return np.array([lut.get(int(v), 0) for v in ints], dtype=np.int32)
+    bounds = m.bin_upper_bound
+    n_numeric = m.num_bin
+    has_nan_bin = m.missing_type == MissingType.NAN
+    if has_nan_bin:
+        n_numeric -= 1
+    search_bounds = bounds[:max(n_numeric - 1, 0)]
+    vals = values.copy()
+    if m.missing_type == MissingType.ZERO:
+        vals = np.where(np.isnan(vals), 0.0, vals)
+    out = np.searchsorted(search_bounds, vals, side="left").astype(np.int32)
+    if has_nan_bin:
+        out = np.where(np.isnan(values), m.num_bin - 1, out)
+    else:
+        out = np.where(np.isnan(values), m.default_bin, out)
+    return out
+
+
+def _make_X(n=3000, seed=7):
+    """Columns engineered to hit every mapper flavor: dense gaussian,
+    sparse with implicit zeros, NaN-bearing (NAN missing type),
+    categorical with unseen/negative/NaN codes, and a constant."""
+    rng = np.random.RandomState(seed)
+    dense = rng.normal(size=n)
+    sparse = np.where(rng.rand(n) < 0.8, 0.0, rng.normal(size=n) * 5)
+    withnan = rng.normal(size=n)
+    withnan[rng.rand(n) < 0.1] = np.nan
+    cat = rng.choice([0, 1, 2, 3, 7, 50], size=n).astype(np.float64)
+    cat[rng.rand(n) < 0.05] = np.nan
+    cat[rng.rand(n) < 0.05] = -3        # negative -> NaN bucket
+    cat[rng.rand(n) < 0.05] = 999       # unseen at high rate -> rare-dropped
+    const = np.full(n, 2.5)
+    return np.column_stack([dense, sparse, withnan, cat, const])
+
+
+@pytest.mark.parametrize("zero_as_missing", [False, True])
+@pytest.mark.parametrize("dtype", [np.uint8, np.uint16])
+def test_bin_columns_matches_reference(zero_as_missing, dtype):
+    X = _make_X()
+    mappers = find_bin_mappers(X, max_bin=31,
+                               zero_as_missing=zero_as_missing,
+                               categorical_features=[3])
+    used = list(range(X.shape[1]))
+    got = bin_columns(X, used, mappers, dtype)
+    ref = np.column_stack([
+        _ref_values_to_bins(mappers[j], X[:, j]) for j in used
+    ]).astype(dtype)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_bin_columns_float32_and_noncontiguous_input():
+    X = _make_X().astype(np.float32)
+    mappers = find_bin_mappers(np.asarray(X, np.float64), max_bin=15,
+                               categorical_features=[3])
+    used = list(range(X.shape[1]))
+    view = X[::2]  # non-contiguous row view, float32 source
+    got = bin_columns(view, used, mappers, np.uint8)
+    ref = np.column_stack([
+        _ref_values_to_bins(mappers[j], np.asarray(view[:, j], np.float64))
+        for j in used
+    ]).astype(np.uint8)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_bin_columns_does_not_mutate_input():
+    # the ZERO-missing rewrite runs in place on the staging buffer —
+    # never on the caller's matrix
+    X = _make_X()
+    before = X.copy()
+    mappers = find_bin_mappers(X, max_bin=31, zero_as_missing=True,
+                               categorical_features=[3])
+    bin_columns(X, list(range(X.shape[1])), mappers, np.uint8)
+    np.testing.assert_array_equal(X, before)
+
+
+def test_values_to_bins_public_api_unchanged():
+    X = _make_X(n=500)
+    mappers = find_bin_mappers(X, max_bin=31, categorical_features=[3])
+    for j, m in enumerate(mappers):
+        got = m.values_to_bins(X[:, j])
+        np.testing.assert_array_equal(got, _ref_values_to_bins(m, X[:, j]))
+        assert got.dtype == np.int32
+
+
+def test_sample_transpose_matches_numpy_chain():
+    # fused native gather+transpose+f64 cast (lgbt_sample_transpose)
+    # must be bit-identical to the NumPy chain it replaces
+    from lightgbm_tpu import cext
+    if not cext.available():
+        pytest.skip("no compiler: native data layer unavailable")
+    rng = np.random.RandomState(11)
+    for dt in (np.float32, np.float64):
+        X = rng.randn(5000, 6).astype(dt)
+        X[rng.rand(5000, 6) < 0.05] = np.nan
+        idx = np.sort(rng.choice(5000, 2000, replace=False))
+        ref = np.ascontiguousarray(X[idx].T, dtype=np.float64)
+        got = cext.sample_transpose(X, idx)
+        assert got.dtype == ref.dtype and got.shape == ref.shape
+        np.testing.assert_array_equal(got, ref)
+
+
+def test_find_bin_mappers_sampled_paths_identical(monkeypatch):
+    # the native fused-sample path and the NumPy fallback must build
+    # identical mappers (same seeded index draw, same sample values)
+    from lightgbm_tpu import cext
+    if not cext.available():
+        pytest.skip("no compiler: native data layer unavailable")
+    rng = np.random.RandomState(12)
+    X = rng.randn(9000, 5).astype(np.float32)
+    X[rng.rand(9000, 5) < 0.03] = np.nan
+    a = find_bin_mappers(X, max_bin=63, sample_cnt=4000)
+    monkeypatch.setattr(cext, "available", lambda: False)
+    b = find_bin_mappers(X, max_bin=63, sample_cnt=4000)
+    for ma, mb in zip(a, b):
+        assert ma.num_bin == mb.num_bin
+        assert ma.missing_type == mb.missing_type
+        np.testing.assert_array_equal(np.asarray(ma.bin_upper_bound),
+                                      np.asarray(mb.bin_upper_bound))
+
+
+def test_bin_columns_native_all_numeric_matches_numpy(monkeypatch):
+    # above the native row threshold with every feature numeric,
+    # bin_columns returns the kernel output directly (no fancy-index
+    # copy) — ids and dtype must match the NumPy path exactly
+    from lightgbm_tpu import cext
+    if not cext.available():
+        pytest.skip("no compiler: native data layer unavailable")
+    rng = np.random.RandomState(13)
+    X = np.ascontiguousarray(rng.randn(20001, 4).astype(np.float32))
+    X[rng.rand(20001, 4) < 0.02] = np.nan
+    mappers = find_bin_mappers(X, max_bin=255)
+    used = list(range(4))
+    nat = bin_columns(X, used, mappers, np.uint8)
+    monkeypatch.setattr(cext, "available", lambda: False)
+    ref = bin_columns(X, used, mappers, np.uint8)
+    assert nat.dtype == ref.dtype and nat.shape == ref.shape
+    np.testing.assert_array_equal(nat, ref)
+
+
+def test_categorical_lut_semantics_exact():
+    # float codes truncate like int(v); negatives, NaN, +/-inf and codes
+    # absent from training all land in dummy bin 0 / the -1 bucket
+    m = BinMapper.from_sample(
+        np.asarray([1.0, 1.0, 2.0, 2.0, 2.0, 5.0], np.float64),
+        total_sample_cnt=6, max_bin=10, is_categorical=True)
+    probe = np.asarray([1.0, 2.0, 2.9, 5.0, 6.0, -1.0, -7.3,
+                        np.nan, np.inf, -np.inf, 0.0], np.float64)
+    np.testing.assert_array_equal(m.values_to_bins(probe),
+                                  _ref_values_to_bins(m, probe))

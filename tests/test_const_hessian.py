@@ -113,6 +113,62 @@ class TestConstHessian:
         bw = lgb.Booster(params=dict(params), train_set=dsw)
         assert bw.gbdt._mxu_grow_kwargs()["const_hessian"] == 0.0
 
+    def test_nonunit_constant_hessian_value_respected(self):
+        # an objective promising hess == 2 x row must reach the kernels
+        # as const_hessian=2.0 (not the old hardcoded 1.0, which would
+        # reconstruct hessian sums as 1 x count and silently halve every
+        # leaf's H) — fast path on vs off must agree exactly
+        from lightgbm_tpu.objectives import RegressionL2
+
+        class ScaledL2(RegressionL2):
+            name = "scaled_l2"
+            constant_hessian_value = 2.0
+
+            def get_gradients(self, score):
+                grad = 2.0 * (score - self.trans_label)
+                hess = 2.0 * jnp.ones_like(score)
+                return self._weighted(grad, hess)
+
+        X, y, _, _ = _reg_setup(n=300, f=4, seed=11)
+        params = {"objective": "regression", "num_leaves": 7,
+                  "max_bin": 31, "learning_rate": 0.2, "verbosity": -1,
+                  "min_data_in_leaf": 5}
+
+        def build(force_const_off=False):
+            ds = lgb.Dataset(X, label=y, params={"max_bin": 31})
+            bst = lgb.Booster(params=dict(params), train_set=ds)
+            gb = bst.gbdt
+            swapped = ScaledL2(gb.config)
+            swapped.label = gb.objective.label
+            swapped.trans_label = gb.objective.trans_label
+            swapped.weight = None
+            swapped.num_data = gb.objective.num_data
+            gb.objective = swapped
+            gb._fused_run = None  # drop closure baked over the old obj
+            gb._hist_impl = "mxu"
+            gb._mxu_interpret = True
+            if force_const_off:
+                orig = gb._mxu_grow_kwargs
+
+                def no_const():
+                    kw = orig()
+                    kw["const_hessian"] = 0.0
+                    return kw
+
+                gb._mxu_grow_kwargs = no_const
+            return bst
+
+        a, b = build(), build(force_const_off=True)
+        assert a.gbdt._const_hessian() == 2.0
+        assert a.gbdt._mxu_grow_kwargs()["const_hessian"] == 2.0
+        assert b.gbdt._mxu_grow_kwargs()["const_hessian"] == 0.0
+        for _ in range(2):
+            a.update()
+            b.update()
+        np.testing.assert_array_equal(np.asarray(a.gbdt.train_score),
+                                      np.asarray(b.gbdt.train_score))
+        assert a.model_to_string() == b.model_to_string()
+
     def test_sharded_learner_keeps_const_hessian_off(self, monkeypatch):
         # the sharded learner's mxu kwargs are baked before
         # objective.init() binds weights, so the gate must stay OFF

@@ -26,8 +26,8 @@ PACKAGE_DIR = os.path.dirname(os.path.abspath(lightgbm_tpu.__file__))
 
 ALL_RULE_IDS = (
     "DTYPE001", "DTYPE002", "FAULT001", "JIT001", "JIT002", "JIT003",
-    "LOCK001", "LOCK002", "REG001", "REG002", "REG003", "REG004",
-    "REG005",
+    "JIT004", "LOCK001", "LOCK002", "REG001", "REG002", "REG003",
+    "REG004", "REG005",
 )
 
 
@@ -71,6 +71,17 @@ def test_jit_rules_fire():
         ("JIT003", 31),   # bool(x[0])
         ("JIT003", 32),   # x.max().item()
     }
+
+
+def test_donation_reuse_rule_fires():
+    findings = run_on("learner/donate_bad.py")
+    assert hits(findings) == {
+        ("JIT004", 17),   # out + score after score donated by keyword
+        ("JIT004", 29),   # carry read after positional donation
+    }
+    # rebind-from-result, attribute receivers, and store-before-read
+    # must stay silent
+    assert not any("ok_" in (f.message or "") for f in findings)
 
 
 def test_dtype_rules_fire():
