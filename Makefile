@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-all faults observe lint pipeline bench install
+.PHONY: test test-slow test-all faults observe lint pipeline kernels bench install
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -26,6 +26,15 @@ lint:
 pipeline:
 	$(PY) -m pytest tests/ -x -q -m "pipeline and not slow"
 	$(PY) -m pytest tests/ -x -q -m "pipeline and slow"
+
+# the histogram-kernel tier: scatter/mxu/oracle parity (incl.
+# adversarial bin distributions and the quantized bit-exactness
+# contract), hist_backend resolution + autotune (tests/
+# test_hist_backends.py, docs/Performance.md) — the fast subset is
+# tier-1; `-m "kernels and slow"` adds tree/model byte-parity
+kernels:
+	$(PY) -m pytest tests/ -x -q -m "kernels and not slow"
+	$(PY) -m pytest tests/ -x -q -m "kernels and slow"
 
 # the fault-injection tier: every registered reliability site fired and
 # recovered (tests/test_reliability.py, docs/Reliability.md)

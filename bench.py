@@ -101,7 +101,14 @@ PARAMS = {"objective": "binary", "num_leaves": NUM_LEAVES,
           "min_data_in_leaf": 20, "use_quantized_grad": True,
           "growth_overshoot": float(os.environ.get("BENCH_OVERSHOOT",
                                                    1.75)),
-          "growth_bridge_gate": 0.93}
+          "growth_bridge_gate": 0.93,
+          # histogram kernel: "auto" autotunes mxu vs the Pallas
+          # scatter kernel on device and pins the winner (byte-neutral
+          # in the quantized posture). Pin explicitly to measure one
+          # backend, e.g. LGBM_TPU_HIST_BACKEND=mxu for the pre-kernel
+          # attribution point (docs/Performance.md r06 protocol).
+          "hist_backend": os.environ.get("LGBM_TPU_HIST_BACKEND",
+                                         "auto")}
 # Bench posture vs library defaults (both A/B'd, docs/PerfNotes.md):
 # - use_quantized_grad: stochastically-rounded integer gradients with
 #   exact leaf refit. Round-3 A/B: 2.31 vs 1.74 trees/s, AUC@95
@@ -421,6 +428,13 @@ def main():
             # binary log-loss has non-constant hessians, so the
             # const-hessian channel drop never applies) x measured rate
             from lightgbm_tpu.observability import mfu as _mfu
+            from lightgbm_tpu.observability import registry as _obs
+            if _obs.hist_backend_snapshot()["choice"] not in ("", "mxu"):
+                # the analytic MAC form models the one-hot matmul
+                # kernel only; the scatter kernels are partition-
+                # shaped, so MFU honestly reads unavailable
+                raise RuntimeError("no MAC model for the scatter "
+                                   "histogram backend")
             tmacs = _mfu.tree_macs(
                 num_leaves=NUM_LEAVES, num_rows=N_ROWS,
                 num_features=N_FEATURES, bmax=MAX_BIN,
@@ -437,6 +451,15 @@ def main():
         except Exception as exc:
             print(f"# device-utilization accounting failed: {exc}",
                   file=sys.stderr)
+    try:
+        # which histogram backend actually ran (+ autotune timings) —
+        # pinned once per process by GBDT._resolved_hist_backend and
+        # recorded regardless of the observability enable flag
+        from lightgbm_tpu.observability import registry as _obs
+        result["hist_backend"] = _obs.hist_backend_snapshot()
+    except Exception as exc:
+        print(f"# hist-backend record unavailable: {exc}",
+              file=sys.stderr)
     _pipeline_bench(bench, result)
     _serve_bench(bench, result)
     _task_bench(result)
@@ -483,6 +506,14 @@ def _report(result, block_times, block_trees, bench):
                   f"{result['achieved_tflops']:.3f} achieved TFLOP/s "
                   f"from analytic histogram MACs "
                   f"(observability/mfu.py, slight lower bound), {mfu_s}",
+                  file=sys.stderr)
+        hb = result.get("hist_backend") or {}
+        if hb.get("choice"):
+            tim = ", ".join(f"{k[:-3]} {v:.2f}ms"
+                            for k, v in sorted(hb.items())
+                            if k.endswith("_ms"))
+            print(f"# histogram backend: {hb['choice']} "
+                  f"({'autotuned: ' + tim if hb.get('autotuned') else 'pinned'})",
                   file=sys.stderr)
         for row in result.get("tasks", []):
             print(f"# task {row['task']}: {row['value']:.2f} trees/sec "

@@ -216,6 +216,7 @@ def all_rules() -> List[Rule]:
                             JitPythonControlFlowRule,
                             JitStaticScalarRule)
     from .rules_lock import LockDisciplineRule, LockOrderRule
+    from .rules_pallas import PallasKernelRule
     from .rules_registry import (CliTaskRoutingRule, ConfigAttrRule,
                                  FaultSiteRegistryRule, ParamDocsRule,
                                  PrometheusDocsRule)
@@ -224,6 +225,7 @@ def all_rules() -> List[Rule]:
         JitHostSyncRule(), JitDonationReuseRule(),
         DtypeF64Rule(), DtypePromotionRule(),
         LockDisciplineRule(), LockOrderRule(),
+        PallasKernelRule(),
         ParamDocsRule(), CliTaskRoutingRule(), ConfigAttrRule(),
         FaultSiteRegistryRule(), PrometheusDocsRule(),
         FaultCoverageRule(),

@@ -1,0 +1,147 @@
+"""Pallas kernel-hygiene rule (device code paths only).
+
+PALLAS001 enforces the two conventions every `pl.pallas_call` site in
+this codebase must follow, because both failure modes are silent or
+cryptic at the Mosaic level:
+
+1. **Block shapes must be declared.** Every pallas_call must pass
+   either a `grid_spec=` (the PrefetchScalarGridSpec form) or both
+   `in_specs=` and `out_specs=` BlockSpec declarations. A call without
+   them lowers with whole-array blocks — on real shapes that either
+   blows the VMEM budget at compile time with an opaque Mosaic error
+   or, worse, works on toy tests and OOMs at the bench shape.
+
+2. **Kernel bodies must not close over traced values.** A kernel
+   function (or a kernel-factory call) evaluated inside a *jitted*
+   function must not capture the jitted function's traced parameters —
+   those are tracers at kernel-build time, and Pallas kernels can only
+   close over static Python values; traced inputs must flow through
+   pallas_call operands so they get a BlockSpec and a VMEM window.
+   Kernel *factories* at module scope (`_hist_kernel(nb, f, b, ...)`)
+   capture static ints and are the idiomatic pattern — they only fire
+   the rule when fed a traced parameter name.
+
+What does NOT fire, by design: nested functions inside jitted code
+that are NOT passed to pallas_call (scan/cond bodies legitimately
+close over traced values), and factories whose arguments are statics
+or locals derived from `static_argnames` parameters.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .engine import Finding, ParsedFile, Rule
+from .rules_jit import _dotted_name, iter_jitted_functions
+
+__all__ = ["PallasKernelRule"]
+
+
+def _is_pallas_call(node: ast.Call) -> bool:
+    name = _dotted_name(node.func)
+    return bool(name) and name.split(".")[-1] == "pallas_call"
+
+
+def _has_block_decls(node: ast.Call) -> bool:
+    kws = {kw.arg for kw in node.keywords if kw.arg}
+    return "grid_spec" in kws or {"in_specs", "out_specs"} <= kws
+
+
+def _assigned_names(func: ast.FunctionDef) -> Set[str]:
+    """Names bound inside `func` (params, assignments, for-targets,
+    comprehension targets, inner defs) — everything that shadows an
+    outer-scope capture."""
+    names: Set[str] = set()
+    a = func.args
+    for arg in (list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)):
+        names.add(arg.arg)
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    for node in ast.walk(func):
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, (ast.Store, ast.Del)):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(node.name)
+    return names
+
+
+def _free_loads(func: ast.FunctionDef) -> Set[str]:
+    bound = _assigned_names(func)
+    return {node.id for node in ast.walk(func)
+            if isinstance(node, ast.Name) and
+            isinstance(node.ctx, ast.Load) and node.id not in bound}
+
+
+class PallasKernelRule(Rule):
+    id = "PALLAS001"
+    severity = "error"
+    doc = ("pl.pallas_call must declare VMEM block shapes (grid_spec= "
+           "or in_specs=+out_specs=), and kernels built inside jitted "
+           "functions must not close over traced parameters — traced "
+           "data reaches a kernel only through pallas_call operands")
+
+    def check(self, parsed: ParsedFile) -> List[Finding]:
+        if parsed.tree is None or not parsed.in_device_dir():
+            return []
+        findings: List[Finding] = []
+        calls = [node for node in ast.walk(parsed.tree)
+                 if isinstance(node, ast.Call) and _is_pallas_call(node)]
+        if not calls:
+            return []
+        for call in calls:
+            if not _has_block_decls(call):
+                findings.append(self.finding(
+                    parsed, call.lineno,
+                    "pallas_call without block-shape declarations: pass "
+                    "grid_spec= or both in_specs= and out_specs= (whole-"
+                    "array default blocks OOM VMEM at real shapes)"))
+        for func, static, _via in iter_jitted_functions(parsed.tree):
+            traced = {a.arg for a in (list(func.args.posonlyargs) +
+                                      list(func.args.args) +
+                                      list(func.args.kwonlyargs))
+                      if a.arg not in static}
+            if not traced:
+                continue
+            local_defs = {n.name: n for n in ast.walk(func)
+                          if isinstance(n, ast.FunctionDef) and
+                          n is not func}
+            for call in calls:
+                if not self._inside(func, call) or not call.args:
+                    continue
+                findings.extend(self._check_kernel_arg(
+                    parsed, call.args[0], traced, local_defs))
+        return findings
+
+    @staticmethod
+    def _inside(func: ast.FunctionDef, node: ast.AST) -> bool:
+        return any(node is n for n in ast.walk(func))
+
+    def _check_kernel_arg(self, parsed: ParsedFile, kernel: ast.expr,
+                          traced: Set[str],
+                          local_defs) -> List[Finding]:
+        findings: List[Finding] = []
+        if isinstance(kernel, ast.Name):
+            target: Optional[ast.FunctionDef] = local_defs.get(kernel.id)
+            if target is not None:
+                for name in sorted(_free_loads(target) & traced):
+                    findings.append(self.finding(
+                        parsed, target.lineno,
+                        f"pallas kernel '{target.name}' closes over "
+                        f"traced parameter '{name}' of its jitted "
+                        "enclosing function; route it through a "
+                        "pallas_call operand with a BlockSpec"))
+        elif isinstance(kernel, ast.Call):
+            args = list(kernel.args) + [kw.value for kw in kernel.keywords]
+            for arg in args:
+                if isinstance(arg, ast.Name) and arg.id in traced:
+                    findings.append(self.finding(
+                        parsed, kernel.lineno,
+                        f"kernel factory receives traced parameter "
+                        f"'{arg.id}'; factories may only capture static "
+                        "values — traced data reaches a kernel through "
+                        "pallas_call operands"))
+        return findings

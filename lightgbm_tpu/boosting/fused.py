@@ -110,6 +110,12 @@ def build_fused_train(*, objective, bins, cnt_weight, feature_mask_fn,
 
     shrink = jnp.float32(shrinkage)
     interpret = bool(grower_kwargs.get("interpret", False))
+    # the histogram backend is a static grow arg and must reach the
+    # scan already resolved — "auto" here would mean the caller skipped
+    # GBDT._resolved_hist_backend and each recompile could re-decide
+    if grower_kwargs.get("hist_backend", "mxu") == "auto":
+        raise ValueError("build_fused_train requires a resolved "
+                         "hist_backend (mxu|pallas|scatter), not 'auto'")
 
     def one_tree(grad, hess, cnt, fmask, it):
         rng = jax.random.fold_in(jax.random.PRNGKey(extra_seed), it) \

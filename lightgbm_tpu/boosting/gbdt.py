@@ -245,6 +245,12 @@ class GBDT:
             self._hist_impl = "scatter"
         Log.debug("Tree kernel path: %s (backend=%s)", self._hist_impl,
                   backend)
+        # histogram backend for the MXU growth path (config.hist_backend)
+        # — resolved lazily in _resolved_hist_backend() because "auto"
+        # autotunes on the device bin matrix, which must happen after
+        # objective binding and 4-bit packing are final
+        self._hist_backend = None
+        self._hist_autotune = None
         if cfg.use_quantized_grad and self._hist_impl != "mxu" and \
                 not getattr(self, "_sharded_mxu", False):
             Log.warning("use_quantized_grad only accelerates the MXU "
@@ -266,11 +272,15 @@ class GBDT:
                 if cfg.growth_overshoot >= 1.0 else cfg.num_leaves
             if fits_v2(L_g + 1, ds.num_features, self.bmax,
                        cfg.gpu_use_dp, cfg.use_quantized_grad):
-                self.bins = None  # free the unpacked device copy first
-                self.bins = jnp.asarray(pack_bins_4bit(ds.bins))
-                self._packed4 = True
-                Log.debug("bin matrix packed 4-bit: [%d, %d] bytes",
-                          ds.num_data, self.bins.shape[1])
+                # pack_bins_4bit refuses (None + warning) if any bin id
+                # exceeds 15 — keep uint8 storage rather than truncate
+                packed = pack_bins_4bit(ds.bins)
+                if packed is not None:
+                    self.bins = None  # free the unpacked copy first
+                    self.bins = jnp.asarray(packed)
+                    self._packed4 = True
+                    Log.debug("bin matrix packed 4-bit: [%d, %d] bytes",
+                              ds.num_data, self.bins.shape[1])
         # linear trees (reference LinearTreeLearner; raw values required,
         # dataset.cpp:418-420)
         self._linear = bool(cfg.linear_tree)
@@ -601,6 +611,61 @@ class GBDT:
             self._fused_run = None
             self._obs_tree_macs = None
 
+    def _resolved_hist_backend(self) -> str:
+        """Resolve config.hist_backend to a concrete kernel for
+        grow_tree_mxu. The backend is a static (jit) argument, so
+        resolution happens host-side before the first dispatch and the
+        answer is pinned for the run.
+
+        "auto" considers the Pallas scatter kernel only in the
+        quantized posture — there integer histogram sums make the two
+        backends bit-identical (byte-equal model.txt either way), so
+        the autotuned choice is purely a speed knob. Exact mode differs
+        in last-ulp summation order, so auto pins mxu and switching
+        requires an explicit hist_backend. EFB growth has no scatter
+        wiring (bundle-space routing stays on the mxu sweep), and on
+        CPU hosts there is nothing real to time — both pin mxu."""
+        if self._hist_backend is not None:
+            return self._hist_backend
+        cfg = self.config
+        hb = cfg.hist_backend
+        timings: dict = {}
+        autotuned = False
+        if self._efb is not None and hb not in ("auto", "mxu"):
+            Log.warning("hist_backend=%s has no EFB bundle-space "
+                        "wiring; using mxu", hb)
+            hb = "mxu"
+        elif hb == "auto":
+            if (self._efb is not None or
+                    jax.default_backend() == "cpu" or
+                    not cfg.hist_autotune or
+                    not cfg.use_quantized_grad):
+                hb = "mxu"
+            else:
+                import math as _math
+                from ..learner.grower_mxu import (_kernel_cap,
+                                                  autotune_hist_backend)
+                over = cfg.growth_overshoot \
+                    if cfg.growth_overshoot >= 1.0 else 1.0
+                s_max = int(_math.ceil(cfg.num_leaves * over)) + 1
+                s_rep = max(2, _kernel_cap(s_max)
+                            if cfg.hist_subtraction else s_max)
+                hb, timings = autotune_hist_backend(
+                    self.bins, num_slots=s_rep, bmax=self.bmax,
+                    num_features=(int(self.num_bins_d.shape[0])
+                                  if self._packed4 else 0),
+                    double_prec=cfg.gpu_use_dp, quantized=True,
+                    const_hess=self._const_hessian())
+                autotuned = True
+                Log.info("hist_backend=auto picked %s (%s)", hb,
+                         ", ".join("%s=%.2fms" % kv
+                                   for kv in sorted(timings.items())))
+        self._hist_backend = hb
+        self._hist_autotune = {"choice": hb, "autotuned": autotuned,
+                               "timings_ms": dict(timings)}
+        _obs.record_hist_autotune(hb, timings, autotuned)
+        return hb
+
     def _mxu_grow_kwargs(self):
         """Static grow_tree_mxu settings — single source shared by the
         per-iteration path (_grow) and the fused scan (_build_fused) so
@@ -620,6 +685,7 @@ class GBDT:
             bridge_gate=cfg.growth_bridge_gate,
             quantized_grad=cfg.use_quantized_grad,
             packed4=self._packed4,
+            hist_backend=self._resolved_hist_backend(),
             interpret=getattr(self, "_mxu_interpret", False))
 
     def _grow(self, g, h, cnt, feature_mask):

@@ -343,6 +343,22 @@ _PARAMS: List[ParamSpec] = [
             "MXU histogram kernels (3 channels instead of 5, ~1.5x "
             "faster); leaf values are refit exactly afterwards, so "
             "quantization only perturbs the split search"),
+    _p("hist_backend", str, "auto", (),
+       lambda v: v in ("auto", "mxu", "pallas", "scatter"),
+       "histogram kernel for the serial MXU growth path: 'mxu' = "
+       "one-hot x MXU matmul (histogram_mxu.py), 'pallas' = "
+       "slot-grouped scatter-accumulate kernel (histogram_pallas.py; "
+       "per-row cost independent of frontier width), 'scatter' = "
+       "pure-XLA segment sums (the parity oracle). 'auto' runs a "
+       "one-shot on-device autotune of mxu vs pallas and pins the "
+       "winner for the run (quantized posture only — there the "
+       "backends are bit-identical, so the choice is byte-neutral on "
+       "model.txt; exact mode pins mxu). The decision and per-backend "
+       "timings land in observability and the bench JSON"),
+    _p("hist_autotune", bool, True, (),
+       desc="allow hist_backend='auto' to time both kernels on device "
+            "before pinning one; false pins mxu without measuring "
+            "(deterministic startup, e.g. for profiling runs)"),
     _p("fused_block_size", int, 10, (), lambda v: v >= 1,
        "iterations per fused on-device dispatch in engine.train when "
        "the config is fused-eligible (boosting/fused.py). Metrics, "

@@ -31,16 +31,21 @@ from __future__ import annotations
 import functools
 import math
 import os
+import time
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from ..utils.log import Log
 from .grower import _init_tree, TreeArrays
+from .histogram import build_histograms
 from .histogram_mxu import (_round_up, build_histograms_mxu_auto, fits_v2,
                             fused_route_hist_mxu, node_sums_mxu,
                             node_values_mxu, pack_route_tables,
-                            quantize_gradients, route_rows_mxu)
+                            quantize_gradients, route_rows_mxu,
+                            unpack_bins_4bit)
+from .histogram_pallas import build_histograms_scatter
 from .split import (BestSplits, SplitHyperParams, find_best_splits,
                     leaf_gain, leaf_output, _split_gain)
 from .split_kernel import find_best_splits_kernel, kernel_supports
@@ -173,6 +178,58 @@ def _kernel_cap(s: int) -> int:
     return min(s, s // 2 + 8)
 
 
+def autotune_hist_backend(bins, *, num_slots: int, bmax: int,
+                          num_features: int = 0, double_prec: bool = True,
+                          quantized: bool = True, const_hess: float = 0.0,
+                          row_block_scatter: int = 1024):
+    """One-shot on-device histogram-backend measurement (hist_backend=
+    auto): build one frontier histogram at the dominant frontier width
+    with the MXU one-hot kernel and the Pallas scatter kernel on the
+    REAL bin matrix, time the post-compile call of each, and return
+    (choice, timings_ms). Synthetic gradients/slots are used — kernel
+    runtime is data-independent (dense dots, static shapes), so the
+    measurement transfers to training. Runs host-side BEFORE the first
+    grow_tree_mxu dispatch because the backend is a static (jit) arg;
+    the result is pinned for the whole run and recorded in
+    observability (boosting/gbdt.py). A backend that fails to compile
+    or run times as +inf, so the other one wins."""
+    n = bins.shape[0]
+    g = jnp.linspace(-127.0, 127.0, n, dtype=jnp.float32)
+    g = jnp.round(g) if quantized else g * 1e-2
+    h = jnp.ones(n, jnp.float32)
+    cnt = jnp.ones(n, jnp.float32)
+    slot = (jnp.arange(n, dtype=jnp.int32) % num_slots)
+
+    def _mxu():
+        return build_histograms_mxu_auto(
+            bins, g, h, cnt, slot, num_slots=num_slots, bmax=bmax,
+            double_prec=double_prec, quantized=quantized,
+            num_features=num_features, const_hess=const_hess)
+
+    def _pallas():
+        return build_histograms_scatter(
+            bins, g, h, cnt, slot, num_slots=num_slots, bmax=bmax,
+            double_prec=double_prec, quantized=quantized,
+            num_features=num_features, const_hess=const_hess,
+            row_block=row_block_scatter)
+
+    timings = {}
+    for name, fn in (("mxu", _mxu), ("pallas", _pallas)):
+        try:
+            jax.block_until_ready(fn())       # compile + warm
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            timings[name] = (time.perf_counter() - t0) * 1e3
+        except Exception as exc:  # pragma: no cover - device-specific
+            Log.warning("hist_backend autotune: %s backend failed (%s)",
+                        name, exc)
+            timings[name] = float("inf")
+    choice = min(timings, key=timings.get)
+    if timings[choice] == float("inf"):
+        choice = "mxu"
+    return choice, timings
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("num_leaves", "max_depth", "hp", "bmax",
@@ -181,7 +238,7 @@ def _kernel_cap(s: int) -> int:
                      "hist_subtraction", "overshoot", "bridge_gate",
                      "psum_axis",
                      "quantized_grad", "use_scan_kernel", "packed4",
-                     "const_hessian",
+                     "const_hessian", "hist_backend",
                      "cegb_cfg", "debug_info"))
 def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   cnt_weight: jax.Array, feature_mask: jax.Array,
@@ -203,6 +260,7 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   use_scan_kernel: bool = False,
                   packed4: bool = False,
                   const_hessian: float = 0.0,
+                  hist_backend: str = "mxu",
                   efb=None,
                   forced=None,
                   cegb_cfg=None,
@@ -233,6 +291,20 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     the reference's 4-bit DenseBin, src/io/dense_bin.hpp:42): the kernels
     unpack nibbles in VMEM, so HBM holds half the bin bytes. Exact —
     identical trees to unpacked storage.
+
+    hist_backend selects the per-pass histogram kernel: "mxu" keeps the
+    one-hot matmul kernels (fused route+hist when it fits VMEM),
+    "pallas" routes with route_rows_mxu(emit_counts=True) and builds
+    via the slot-grouped scatter kernel (histogram_pallas — per-row
+    cost independent of the frontier width), "scatter" routes the same
+    way and builds with the XLA segment-sum oracle. Must be a RESOLVED
+    backend, never "auto" — the one-shot autotune
+    (autotune_hist_backend, driven from boosting/gbdt.py) happens
+    before jit dispatch because the choice is a static argument. In the
+    quantized posture all three backends produce bit-identical
+    histograms (integer sums, order-independent below 2^24), hence
+    byte-identical trees. EFB data ignores the selector (bundle-space
+    histograms are an MXU-kernel-only formulation).
 
     efb (EfbDev, efb.py) marks `bins` as the BUNDLED matrix [N, Fb]:
     histograms build in bundle space ([S, Fb, Bb, 3] — the flop and
@@ -393,6 +465,31 @@ def grow_tree_mxu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         if m_cap is not None and m_cap < m_pad:
             tbl_c = tbl_c[:m_cap]
             member_c = member_c[:m_cap]
+        if hist_backend != "mxu" and efb is None:
+            # non-MXU histogram backends: route + per-slot counts in one
+            # sweep (the on-device partition), then build via the
+            # scatter kernel or the XLA oracle
+            rn, rs, cts = route_rows_mxu(
+                bins, row_node, tbl_c, member_c, feat_tbl,
+                num_features=nf_packed, emit_counts=True,
+                num_slots=nslots, interpret=interpret)
+            if hist_backend == "pallas":
+                h = build_histograms_scatter(
+                    bins, h_grad, h_hess, cnt_weight, rs,
+                    num_slots=nslots, bmax=bk, num_features=nf_packed,
+                    quantized=quant, double_prec=hist_double_prec,
+                    const_hess=ch, slot_counts=cts, interpret=interpret)
+            else:  # "scatter": the pure-XLA segment-sum oracle
+                ub = unpack_bins_4bit(bins, f) if packed4 else bins
+                h = build_histograms(ub, h_grad, h_hess, rs, cnt_weight,
+                                     num_slots=nslots, bmax=bk)
+                if ch:
+                    # reconstruct hessian sums exactly as const x count,
+                    # matching the kernel backends' channel drop
+                    h = h.at[..., 1].set(h[..., 2] * jnp.float32(ch))
+            if quant:
+                h = h * hist_scale
+            return _allred(h), rn
         # measured on v5e: small frontiers run ~15% cheaper at half
         # blocks, large ones prefer the wider block. EFB keeps rb=1024
         # in BOTH modes: expansion's original-feature route side needs

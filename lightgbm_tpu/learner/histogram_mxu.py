@@ -41,6 +41,8 @@ import numpy as _np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..utils.log import Log
+
 __all__ = ["build_histograms_mxu", "build_histograms_mxu_v2",
            "build_histograms_mxu_auto", "route_rows_mxu",
            "pack_route_tables", "node_values_mxu", "node_sums_mxu",
@@ -74,8 +76,20 @@ def pack_bins_4bit(bins):
     (features [0..Fh) low, [Fh..F) high — NOT interleaved nibbles) keeps
     per-feature extraction a static column pick + shift/mask inside the
     kernels, with no lane interleave. Accepts numpy or jax input; exact:
-    training on packed storage grows bit-identical trees."""
+    training on packed storage grows bit-identical trees.
+
+    Any bin id above 15 (a caller configuring more bins than a nibble
+    holds — the NaN bin counts) makes packing lossy, so it is refused:
+    returns None with a logged warning and the caller keeps the uint8
+    storage path instead of training on silently truncated bins."""
     xp = jnp if isinstance(bins, jax.Array) else _np
+    vmax = int(bins.max()) if bins.size else 0
+    if vmax > 15:
+        Log.warning(
+            "pack_bins_4bit: bin id %d exceeds the 4-bit limit of 15 "
+            "(max_bin incl. the NaN bin must be <= 15); keeping uint8 "
+            "bin storage", vmax)
+        return None
     n, f = bins.shape
     fh = (f + 1) // 2
     lo = bins[:, :fh].astype(xp.uint8)
@@ -976,11 +990,15 @@ def pack_route_tables(split_mask, feat, thr, default_left, is_cat,
 
 def _route_kernel(nb: int, f: int, m: int, bpad: int,
                   has_cat: bool = True, fh: int = 0,
-                  has_efb: bool = False, efb_range: bool = False):
+                  has_efb: bool = False, efb_range: bool = False,
+                  counts_spad: int = 0, valid_rows: int = 0):
     # every per-row quantity is kept [nb, 1] (2-D) — Mosaic lowers 2-D
-    # masks/selects cleanly where 1-D bool vectors hit unsupported i1 casts
+    # masks/selects cleanly where 1-D bool vectors hit unsupported i1 casts.
+    # counts_spad > 0: the same sweep also accumulates per-slot row counts
+    # ([8, counts_spad] f32 broadcast rows, exact to 2^24) — routing AND
+    # the partition metadata of the scatter histogram in one pass.
     def kernel(node_ref, bins_ref, tbl_ref, member_ref, feat_tbl_ref,
-               loc_ref, out_ref):
+               loc_ref, out_ref, *counts_refs):
         node = node_ref[:]                                   # [nb, 1] i32
         iota_m = jax.lax.broadcasted_iota(jnp.int32, (nb, m), 1)
         # bf16 operands are exact here: table entries <= 256 by design
@@ -1018,16 +1036,37 @@ def _route_kernel(nb: int, f: int, m: int, bpad: int,
             out_ref[:] = jnp.concatenate(
                 [new_node_f, new_slot_f], axis=1).astype(jnp.int32)
 
+        if counts_spad:
+            counts_ref, = counts_refs
+            ri = pl.program_id(0)
+
+            @pl.when(ri == 0)
+            def _():
+                counts_ref[0] = jnp.zeros_like(counts_ref[0])
+
+            # read the routed slot back (same trick as the fused kernel:
+            # child slots rode along in the parent's table row)
+            slot = out_ref[:, 1:2]                       # [nb, 1] i32
+            iota_s = jax.lax.broadcasted_iota(
+                jnp.int32, (nb, counts_spad), 1)
+            rid = ri * nb + jax.lax.broadcasted_iota(
+                jnp.int32, (nb, counts_spad), 0)
+            ohc = ((slot == iota_s) & (rid < valid_rows)) \
+                .astype(jnp.float32)                     # [nb, spad]
+            csum = jnp.sum(ohc, axis=0, keepdims=True)   # [1, spad]
+            counts_ref[0] += jnp.broadcast_to(csum, (8, counts_spad))
+
     return kernel
 
 
 @functools.partial(
     jax.jit, static_argnames=("row_block", "num_features", "efb_range",
-                              "interpret"))
+                              "interpret", "emit_counts", "num_slots"))
 def route_rows_mxu(bins: jax.Array, row_node: jax.Array, tbl: jax.Array,
                    member: jax.Array, feat_tbl: jax.Array, *,
                    row_block: int = 0, num_features: int = 0,
                    loc_table=None, efb_range: bool = False,
+                   emit_counts: bool = False, num_slots: int = 0,
                    interpret: bool = False):
     """Advance rows one level and emit (new row_node, new row_slot).
 
@@ -1038,6 +1077,13 @@ def route_rows_mxu(bins: jax.Array, row_node: jax.Array, tbl: jax.Array,
     (expansion fallback); efb_range=True instead runs the bundle-RANGE
     decision off the packed table columns — no loc table, no
     original-feature-width work (pack_route_tables efb=).
+
+    emit_counts=True (requires num_slots > 0): the on-device parallel
+    partition mode — the same sweep additionally returns per-slot row
+    counts [num_slots] i32 (rows whose new slot is s; parked rows
+    excluded), the exact metadata the scatter histogram's
+    partition_rows needs, so routing stops being a count-only second
+    pass. Returns (row_node, row_slot, counts) instead of 2-tuple.
     """
     n, fcols = bins.shape
     has_efb = loc_table is not None and not efb_range
@@ -1076,9 +1122,18 @@ def route_rows_mxu(bins: jax.Array, row_node: jax.Array, tbl: jax.Array,
     loc = loc_table.astype(jnp.float32) if has_efb else \
         jnp.zeros((8, 128), jnp.float32)
     nblocks = (n + npad) // nb
+    spad = ((max(num_slots, 1) + 127) // 128) * 128 if emit_counts else 0
+    out_specs = pl.BlockSpec((nb, 2), lambda ri: (ri, 0))
+    out_shape = jax.ShapeDtypeStruct((n + npad, 2), jnp.int32)
+    if emit_counts:
+        out_specs = [out_specs,
+                     pl.BlockSpec((1, 8, spad), lambda ri: (0, 0, 0))]
+        out_shape = [out_shape,
+                     jax.ShapeDtypeStruct((1, 8, spad), jnp.float32)]
     out = pl.pallas_call(
         _route_kernel(nb, f, m, bpad, fh=fh, has_efb=has_efb,
-                      efb_range=efb_range),
+                      efb_range=efb_range, counts_spad=spad,
+                      valid_rows=n),
         grid=(nblocks,),
         in_specs=[
             pl.BlockSpec((nb, 1), lambda ri: (ri, 0)),
@@ -1088,12 +1143,16 @@ def route_rows_mxu(bins: jax.Array, row_node: jax.Array, tbl: jax.Array,
             pl.BlockSpec((f_route, 2), lambda ri: (0, 0)),
             pl.BlockSpec(loc.shape, lambda ri: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((nb, 2), lambda ri: (ri, 0)),
-        out_shape=jax.ShapeDtypeStruct((n + npad, 2), jnp.int32),
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
         **({} if interpret else {"compiler_params": _COMPILER_PARAMS}),
     )(row_node.astype(jnp.int32)[:, None], bins, tbl, member, feat_tbl,
       loc)
+    if emit_counts:
+        out, counts = out
+        return (out[:n, 0], out[:n, 1],
+                counts[0, 0, :num_slots].astype(jnp.int32))
     return out[:n, 0], out[:n, 1]
 
 

@@ -1,22 +1,40 @@
-"""Pallas TPU histogram kernel: one-hot x MXU matmul over node-blocked rows.
+"""Pallas TPU histogram kernel: slot-grouped scatter-accumulate in VMEM.
 
-This is the TPU answer to the reference's CUDA shared-memory histogram
-kernels (cuda_histogram_constructor.cu:18-307) and the per-thread-buffer
-row-wise path (train_share_states.h:37-80). Scatter-adds serialize on TPU
-(~2 s/pass for 1M x 28 x 256 measured), so the kernel reformulates the
-histogram as matrix multiplication on the MXU:
+The TPU answer to the reference's CUDA shared-memory histogram kernels
+(cuda_histogram_constructor.cu:18-307): per-row scatter-adds serialize
+on the TPU vector units, and the one-hot x MXU kernels in
+histogram_mxu.py pay a per-row cost proportional to the frontier width
+S — their slot-masked channel operand is [row_block, nchan*S], so every
+row is multiplied against every live slot. This kernel removes the S
+factor:
 
-1. rows are grouped by frontier slot (argsort of the row->slot vector) and
-   padded so every `row_block` consecutive rows belong to ONE slot;
-2. each grid step builds the block's one-hot matrix [row_block, F*B] in VMEM
-   (never touching HBM — this is what a pure-XLA one-hot matmul cannot do)
-   and computes `data8 @ onehot` on the MXU: [8, row_block] x
-   [row_block, F*B] -> [8, F*B] — grad/hess/count channels in one pass;
-3. consecutive same-slot blocks accumulate into the same output block, which
-   Pallas keeps resident in VMEM (flash-attention-style revisiting).
+1. rows are partitioned by frontier slot ON DEVICE (partition_rows:
+   argsort of the row->slot vector, padded so every `row_block`
+   consecutive positions belong to ONE slot; the per-slot counts can
+   come straight from route_rows_mxu(emit_counts=True), making routing
+   + partition one pass over the binned matrix);
+2. each grid step builds the block's (feature, bin) one-hots in VMEM
+   and computes `data8 @ onehot` on the MXU — [8, row_block] x
+   [row_block, G*B] per feature group, all channels in one dot. Cost is
+   8 x F x B MACs per row REGARDLESS of S, vs nchan x S x F x B for the
+   one-hot kernels; the scatter path wins once the frontier outgrows
+   ~8/nchan slots, a crossover hist_backend=auto (boosting/gbdt.py)
+   measures on device rather than models;
+3. consecutive same-slot blocks accumulate into the same output block,
+   which Pallas keeps resident in VMEM (flash-attention-style
+   revisiting) — a slot's [8, F*B] accumulator touches HBM once, after
+   its last block, and the f32 final reduce to [S, F, bmax, 3] happens
+   outside the kernel.
 
-Measured on v5e-1: 27 ms/pass for 1M rows x 28 features x 256 bins x 256
-slots vs 2.04 s for the XLA scatter path (75x).
+Accumulation precision: operands ride bf16 like the MXU kernels — in
+quantized mode (use_quantized_grad) the integer gradient channels are
+bf16-exact and the f32 accumulation of integer sums is EXACT while
+every partial stays below 2^24, so histograms (and therefore models)
+are bit-identical across hist_backend settings in the quantized
+posture; exact mode rides the same hi/lo bf16 channel pairs as
+histogram_mxu (~f32-accurate, equal to the MXU path up to last-ulp
+summation-order noise). Bin ids stream as uint8 — or 4-bit packed
+pairs (pack_bins_4bit), unpacked nibble-wise in VMEM.
 """
 
 from __future__ import annotations
@@ -28,121 +46,177 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["build_histograms_pallas"]
+from .histogram_mxu import (_COMPILER_PARAMS, _FGROUP, _combine_hist,
+                            _hist_channels, _packed_cols)
+
+__all__ = ["build_histograms_pallas", "build_histograms_scatter",
+           "partition_rows"]
 
 
-def _hist_kernel(f: int, b: int, nb: int, fchunk: int):
-    # Mosaic collapses [nb, fc, b] -> [nb, fc*b] only when b is a lane
-    # multiple; b is padded to 128k by the caller.
-    fb = f * b
-    nchunks = (f + fchunk - 1) // fchunk
+def partition_rows(row_slot: jax.Array, *, num_slots: int, row_block: int,
+                   counts: jax.Array = None):
+    """Device-side padded partition of rows by frontier slot.
 
-    def kernel(slot_ref, bins_ref, data_ref, out_ref):
-        i = pl.program_id(0)
-        slot = slot_ref[i]
-        prev = slot_ref[jnp.maximum(i - 1, 0)]
-        first = (i == 0) | (slot != prev)
+    Every `row_block` consecutive positions of the returned layout hold
+    rows of ONE slot; the trash slot `num_slots` collects parked rows
+    (slot < 0 / out of range) and layout padding.
 
-        bins_all = bins_ref[:].astype(jnp.int32)            # [Nb, F]
-        data = data_ref[:]                                   # [8, Nb] f32
-        parts = []
-        for ci in range(nchunks):
-            lo = ci * fchunk
-            hi = min(lo + fchunk, f)
-            fc = hi - lo
-            iota_b = jax.lax.broadcasted_iota(jnp.int32, (nb, fc, b), 2)
-            oh = (bins_all[:, lo:hi][:, :, None] == iota_b) \
-                .astype(jnp.float32).reshape(nb, fc * b)
-            parts.append(jax.lax.dot_general(
-                data, oh, dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32))         # [8, fc*B]
-        contrib = jnp.concatenate(parts, axis=1) \
-            if len(parts) > 1 else parts[0]
+    counts: optional per-slot row counts ([num_slots] or longer, e.g.
+    the route_rows_mxu(emit_counts=True) output) — skips the
+    segment_sum here, so routing + partition metadata is a single
+    sweep over the rows.
 
-        @pl.when(first)
-        def _():
-            out_ref[0] = contrib
-
-        @pl.when(~first)
-        def _():
-            out_ref[0] += contrib
-
-    return kernel
-
-
-@functools.partial(
-    jax.jit,
-    static_argnames=("num_slots", "bmax", "row_block", "fchunk"))
-def build_histograms_pallas(bins: jax.Array, grad: jax.Array,
-                            hess: jax.Array, cnt: jax.Array,
-                            row_slot: jax.Array, *, num_slots: int,
-                            bmax: int, row_block: int = 512,
-                            fchunk: int = 7) -> jax.Array:
-    """Histogram for every slot via the Pallas MXU kernel.
-
-    Args match learner.histogram.build_histograms; returns
-    hist [num_slots, F, bmax, 3] float32 (grad, hess, count).
+    Returns (block_slot [TB] i32, src [TB*row_block] i32): src indexes
+    the original rows (n = dummy/padding position) and TB is the static
+    block-count bound ceil(n/row_block) + num_slots + 1.
     """
-    n, f = bins.shape
-    nb = row_block
+    n = row_slot.shape[0]
     s = num_slots
-    b_k = ((bmax + 127) // 128) * 128   # lane-aligned bin axis for Mosaic
-    fb = f * b_k
-
-    # ---- 1. group rows by slot (trash slot s for row_slot < 0) ----
+    nb = row_block
     slot_full = jnp.where((row_slot < 0) | (row_slot >= s), s,
                           row_slot).astype(jnp.int32)
     order = jnp.argsort(slot_full)                        # [N]
-    counts = jax.ops.segment_sum(jnp.ones(n, jnp.int32), slot_full,
-                                 num_segments=s + 1)      # [S+1]
+    if counts is None:
+        counts = jax.ops.segment_sum(jnp.ones(n, jnp.int32), slot_full,
+                                     num_segments=s + 1)  # [S+1]
+    else:
+        live = counts[:s].astype(jnp.int32)
+        counts = jnp.concatenate(
+            [live, (jnp.int32(n) - jnp.sum(live))[None]])
     sort_start = jnp.concatenate(
-        [jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)])
+        [jnp.zeros(1, jnp.int32),
+         jnp.cumsum(counts)[:-1].astype(jnp.int32)])
 
-    # ---- 2. padded block layout: every block holds rows of one slot ----
-    caps = jnp.maximum(1, -(-counts // nb))               # ceil, min 1 block
+    # padded block layout: ceil(count/nb) blocks per slot, min 1
+    caps = jnp.maximum(1, -(-counts // nb))
     tb_max = (n + nb - 1) // nb + s + 1                   # static bound
     blk_start = jnp.concatenate(
         [jnp.zeros(1, jnp.int32), jnp.cumsum(caps).astype(jnp.int32)])
-    # block j belongs to slot searchsorted(blk_start, j, 'right')-1; tail
-    # blocks beyond blk_start[-1] go to the trash slot
+    # block j belongs to slot searchsorted(blk_start, j, 'right')-1;
+    # tail blocks beyond blk_start[-1] go to the trash slot
     j = jnp.arange(tb_max, dtype=jnp.int32)
     block_slot = jnp.clip(
         jnp.searchsorted(blk_start, j, side="right") - 1, 0, s) \
         .astype(jnp.int32)
     block_slot = jnp.where(j >= blk_start[-1], s, block_slot)
 
-    # ---- 3. padded source row per position ----
+    # padded source row per position (n -> dummy row)
     p = jnp.arange(tb_max * nb, dtype=jnp.int32)
     pslot = block_slot[p // nb]
     r = p - blk_start[pslot] * nb                         # offset in slot
     take = (r >= 0) & (r < counts[pslot])
     src_sorted = jnp.clip(sort_start[pslot] + r, 0, n - 1)
-    src = jnp.where(take, order[src_sorted], n)           # n -> dummy row
+    src = jnp.where(take, order[src_sorted], n)
+    return block_slot, src
+
+
+def _scatter_kernel(nb: int, f: int, b: int, fh: int = 0,
+                    mm_dtype=jnp.bfloat16):
+    def kernel(slot_ref, bins_ref, data_ref, out_ref):
+        i = pl.program_id(0)
+        slot = slot_ref[i]
+        prev = slot_ref[jnp.maximum(i - 1, 0)]
+        first = (i == 0) | (slot != prev)
+
+        @pl.when(first)
+        def _():
+            out_ref[0] = jnp.zeros_like(out_ref[0])
+
+        bins_i = bins_ref[:].astype(jnp.int32)           # [Nb, Fcols]
+        data = data_ref[:].astype(mm_dtype)              # [8, Nb]
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (nb, b), 1)
+        for gj in range(0, f, _FGROUP):
+            js = range(gj, min(gj + _FGROUP, f))
+            cols = _packed_cols(bins_i, js, fh) if fh else \
+                [bins_i[:, j:j + 1] for j in js]
+            oh = jnp.concatenate(
+                [(c == iota_b) for c in cols],
+                axis=1).astype(mm_dtype)                 # [Nb, G*B]
+            part = jax.lax.dot_general(
+                data, oh, dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [8, G*B]
+            out_ref[0, :, gj * b:(gj + len(js)) * b] += part
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_slots", "bmax", "row_block", "num_features",
+                     "double_prec", "quantized", "const_hess",
+                     "interpret"))
+def build_histograms_scatter(bins: jax.Array, grad: jax.Array,
+                             hess: jax.Array, cnt: jax.Array,
+                             row_slot: jax.Array, *, num_slots: int,
+                             bmax: int, row_block: int = 1024,
+                             num_features: int = 0,
+                             double_prec: bool = True,
+                             quantized: bool = False,
+                             const_hess: float = 0.0,
+                             slot_counts: jax.Array = None,
+                             interpret: bool = False) -> jax.Array:
+    """Per-slot histograms via the slot-grouped scatter kernel.
+
+    Args mirror build_histograms_mxu_v2; row_slot < 0 routes to no
+    slot. num_features > 0 marks `bins` as 4-bit packed
+    (pack_bins_4bit) with that many logical features. slot_counts:
+    optional per-slot row counts (route_rows_mxu emit_counts) so the
+    partition skips its own counting pass.
+
+    Returns [num_slots, F, bmax, 3] f32 (grad, hess, count).
+    """
+    n, fcols = bins.shape
+    f = num_features if num_features else fcols
+    fh = fcols if num_features else 0
+    nb = row_block
+    s = num_slots
+    b = ((bmax + 127) // 128) * 128      # lane-aligned bin axis
+    fb = f * b
+
+    block_slot, src = partition_rows(row_slot, num_slots=s,
+                                     row_block=nb, counts=slot_counts)
+    tb_max = block_slot.shape[0]
 
     bins_ext = jnp.concatenate(
-        [bins, jnp.zeros((1, f), bins.dtype)], axis=0)
-    bins_pad = bins_ext[src]                              # [TB*Nb, F]
-    zero1 = jnp.zeros(1, jnp.float32)
-    ge = jnp.concatenate([grad.astype(jnp.float32), zero1])
-    he = jnp.concatenate([hess.astype(jnp.float32), zero1])
-    ce = jnp.concatenate([cnt.astype(jnp.float32), zero1])
-    pad5 = jnp.zeros((5, tb_max * nb), jnp.float32)
+        [bins, jnp.zeros((1, fcols), bins.dtype)], axis=0)
+    bins_pad = bins_ext[src]                              # [TB*Nb, Fc]
+    data, nchan = _hist_channels(grad, hess, cnt, double_prec,
+                                 quantized, const_hess)   # [N, 8]
     data8 = jnp.concatenate(
-        [ge[src][None], he[src][None], ce[src][None], pad5], axis=0)
+        [data, jnp.zeros((1, 8), jnp.float32)], axis=0)[src].T
 
-    # ---- 4. kernel ----
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(tb_max,),
-        in_specs=[pl.BlockSpec((nb, f), lambda i, sl: (i, 0)),
+        in_specs=[pl.BlockSpec((nb, fcols), lambda i, sl: (i, 0)),
                   pl.BlockSpec((8, nb), lambda i, sl: (0, i))],
         out_specs=pl.BlockSpec((1, 8, fb), lambda i, sl: (sl[i], 0, 0)))
     out = pl.pallas_call(
-        _hist_kernel(f, b_k, nb, fchunk),
-        out_shape=jax.ShapeDtypeStruct((s + 1, 8, fb), jnp.float32),
+        _scatter_kernel(nb, f, b, fh=fh),
         grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s + 1, 8, fb), jnp.float32),
+        interpret=interpret,
+        **({} if interpret else {"compiler_params": _COMPILER_PARAMS}),
     )(block_slot, bins_pad, data8)
 
-    # [S+1, 8, F*Bk] -> [S, F, B, 3]
-    hist = out[:s, :3].reshape(s, 3, f, b_k)[..., :bmax]
-    return jnp.transpose(hist, (0, 2, 3, 1))
+    # [S+1, 8, F*B] -> the shared postlude layout [1, C*S, F*B]
+    out = jnp.transpose(out[:s, :nchan], (1, 0, 2)).reshape(
+        1, nchan * s, fb)
+    return _combine_hist(out, nchan=nchan, s=s, f=f, b=b, bmax=bmax,
+                         double_prec=double_prec, const_hess=const_hess)
+
+
+def build_histograms_pallas(bins: jax.Array, grad: jax.Array,
+                            hess: jax.Array, cnt: jax.Array,
+                            row_slot: jax.Array, *, num_slots: int,
+                            bmax: int, row_block: int = 1024,
+                            fchunk: int = 0,
+                            interpret: bool = False) -> jax.Array:
+    """Compat contract of the original one-hot kernel for the portable
+    grower (grower.py hist_impl="pallas"): exact full-precision
+    channels on the scatter kernel. fchunk is accepted and ignored (the
+    scatter kernel groups features by _FGROUP)."""
+    del fchunk
+    return build_histograms_scatter(
+        bins, grad, hess, cnt, row_slot, num_slots=num_slots, bmax=bmax,
+        row_block=row_block, interpret=interpret)

@@ -54,6 +54,10 @@ class ObservabilityRegistry:
         # of the block walls the overlapped host work covered
         self._pipeline = {"blocks": 0, "iterations": 0,
                           "host_seconds": 0.0, "wall_seconds": 0.0}
+        # histogram-backend resolution (boosting/gbdt.py
+        # _resolved_hist_backend): the pinned choice + autotune timings
+        self._hist_backend = {"choice": "", "autotuned": False,
+                              "timings_ms": {}}
         # shared singletons, NOT copies — existing call sites in
         # serving/, reliability/ and the phase timeits keep writing to
         # the same objects this registry reads.
@@ -88,6 +92,8 @@ class ObservabilityRegistry:
         with self._lock:
             self._pipeline = {"blocks": 0, "iterations": 0,
                               "host_seconds": 0.0, "wall_seconds": 0.0}
+            self._hist_backend = {"choice": "", "autotuned": False,
+                                  "timings_ms": {}}
 
     # -- exporters ------------------------------------------------------
     def pipeline_snapshot(self) -> Dict:
@@ -100,9 +106,25 @@ class ObservabilityRegistry:
                 "wall_seconds": round(p["wall_seconds"], 6),
                 "overlap_frac": round(frac, 4)}
 
+    def hist_backend_snapshot(self) -> Dict:
+        """The pinned histogram backend as a flat exportable mapping.
+        The string `choice` rides the JSON snapshot/bench tail; the
+        Prometheus exporter skips strings, so the choice is ALSO
+        one-hot encoded (is_mxu/is_pallas/is_scatter) for scrapers."""
+        with self._lock:
+            hb = dict(self._hist_backend)
+        out: Dict = {"choice": hb["choice"],
+                     "autotuned": bool(hb["autotuned"])}
+        for name in ("mxu", "pallas", "scatter"):
+            out["is_" + name] = int(hb["choice"] == name)
+        for name, ms in sorted((hb.get("timings_ms") or {}).items()):
+            out[str(name) + "_ms"] = round(float(ms), 3)
+        return out
+
     def snapshot(self) -> Dict:
         return {
             "enabled": self.enabled,
+            "hist_backend": self.hist_backend_snapshot(),
             "pipeline": self.pipeline_snapshot(),
             "training": self.training.snapshot(),
             "compiles": {"entries": self.compiles.snapshot(),
@@ -126,6 +148,7 @@ class ObservabilityRegistry:
             (snap["compiles"], "lightgbm_tpu_compiles", None),
             (snap["device_utilization"], "lightgbm_tpu_device", None),
             (snap["counters"], "lightgbm_tpu_reliability", None),
+            (snap["hist_backend"], "lightgbm_tpu_hist_backend", None),
             (snap["pipeline"], "lightgbm_tpu_pipeline", None),
             (snap["timers"], "lightgbm_tpu_timer_seconds", None),
             (snap["trace"], "lightgbm_tpu_trace", None),
@@ -135,14 +158,30 @@ class ObservabilityRegistry:
         return self.trace.dump(path, fmt)
 
     # -- training hooks (called from boosting/gbdt.py) ------------------
+    def record_hist_autotune(self, choice: str, timings_ms: Dict,
+                             autotuned: bool) -> None:
+        """Pin the resolved histogram backend (+ per-backend autotune
+        timings, ms). Recorded even when disabled — this is one-shot
+        startup configuration, not per-iteration telemetry, and the
+        bench JSON tail reads it regardless of the enable flag."""
+        with self._lock:
+            self._hist_backend = {
+                "choice": str(choice), "autotuned": bool(autotuned),
+                "timings_ms": {str(k): float(v)
+                               for k, v in (timings_ms or {}).items()}}
+
     def tree_macs_for(self, gbdt) -> int:
         """Analytic per-tree MAC estimate for this booster's config;
-        cached on the booster. 0 off the MXU path (no MAC model)."""
+        cached on the booster. 0 off the MXU path (no MAC model) —
+        including when hist_backend resolves to the scatter kernels,
+        whose cost is partition- not matmul-shaped: MFU then reads as
+        unavailable rather than invented (docs/Observability.md)."""
         cached = getattr(gbdt, "_obs_tree_macs", None)
         if cached is not None:
             return cached
         macs = 0
-        if getattr(gbdt, "_hist_impl", None) == "mxu":
+        if (getattr(gbdt, "_hist_impl", None) == "mxu" and
+                getattr(gbdt, "_hist_backend", None) in (None, "mxu")):
             cfg = gbdt.config
             macs = tree_macs(
                 num_leaves=cfg.num_leaves, num_rows=gbdt.num_data,

@@ -1,0 +1,65 @@
+"""PALLAS001 fixtures: undeclared block shapes + traced closures."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _good_factory(nb):
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * nb
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("nb",))
+def no_block_decls(x, *, nb):
+    # line below: pallas_call without grid_spec or in_specs/out_specs
+    return pl.pallas_call(
+        _good_factory(nb),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+@functools.partial(jax.jit, static_argnames=("nb",))
+def traced_closure(x, scale, *, nb):
+    def kernel(x_ref, o_ref):
+        # `scale` is a traced parameter of the jitted enclosing
+        # function — a tracer at kernel-build time
+        o_ref[...] = x_ref[...] * scale
+    return pl.pallas_call(
+        kernel,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(x.shape, lambda i: (0, 0))],
+        out_specs=pl.BlockSpec(x.shape, lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+def _bad_factory(scale):
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * scale
+    return kernel
+
+
+@jax.jit
+def traced_factory_arg(x, scale):
+    return pl.pallas_call(
+        _bad_factory(scale),  # traced arg baked into the kernel
+        grid=(1,),
+        in_specs=[pl.BlockSpec(x.shape, lambda i: (0, 0))],
+        out_specs=pl.BlockSpec(x.shape, lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+@functools.partial(jax.jit, static_argnames=("nb",))
+def clean(x, *, nb):
+    # statics through the factory, traced data through operands: clean
+    return pl.pallas_call(
+        _good_factory(nb),
+        grid=(1,),
+        in_specs=[pl.BlockSpec(x.shape, lambda i: (0, 0))],
+        out_specs=pl.BlockSpec(x.shape, lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

@@ -26,8 +26,8 @@ PACKAGE_DIR = os.path.dirname(os.path.abspath(lightgbm_tpu.__file__))
 
 ALL_RULE_IDS = (
     "DTYPE001", "DTYPE002", "FAULT001", "JIT001", "JIT002", "JIT003",
-    "JIT004", "LOCK001", "LOCK002", "REG001", "REG002", "REG003",
-    "REG004", "REG005",
+    "JIT004", "LOCK001", "LOCK002", "PALLAS001", "REG001", "REG002",
+    "REG003", "REG004", "REG005",
 )
 
 
@@ -117,6 +117,17 @@ def test_suppression_reports_but_does_not_count():
     assert hits(findings) == {("JIT003", 10), ("LOCK001", 23)}
     assert all(f.suppressed for f in findings)
     assert not [f for f in findings if not f.suppressed]
+
+
+def test_pallas_kernel_rule_fires():
+    findings = run_on("learner/pallas_bad.py")
+    assert hits(findings) == {
+        ("PALLAS001", 18),  # pallas_call without grid_spec/in+out_specs
+        ("PALLAS001", 26),  # kernel closes over traced `scale`
+        ("PALLAS001", 48),  # factory called with traced `scale`
+    }
+    # the static-factory + operand pattern (clean) must stay silent
+    assert not any(f.line > 55 for f in findings)
 
 
 def test_clean_fixture_is_silent():
