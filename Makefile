@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-all faults observe lint pipeline kernels bench install
+.PHONY: test test-slow test-all faults observe lint pipeline kernels stream bench install
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -35,6 +35,13 @@ pipeline:
 kernels:
 	$(PY) -m pytest tests/ -x -q -m "kernels and not slow"
 	$(PY) -m pytest tests/ -x -q -m "kernels and slow"
+
+# the out-of-core streaming tier: sketch/bin parity, adversarial chunk
+# layouts, model.txt byte-parity vs in-memory, mid-stream checkpoint
+# resume (tests/test_streaming.py, docs/Streaming.md) — fast subset is
+# tier-1; `-m "streaming and slow"` adds the 10M-row bounded-memory smoke
+stream:
+	$(PY) -m pytest tests/ -x -q -m "streaming and not slow"
 
 # the fault-injection tier: every registered reliability site fired and
 # recovered (tests/test_reliability.py, docs/Reliability.md)

@@ -342,6 +342,79 @@ def _task_bench(result):
             if anchor else 0.0})
 
 
+def _parse_synth_argv(argv=None):
+    """`--synth rows=10000000,cols=28[,chunk=262144][,seed=17]` (or the
+    `--synth=...` form) -> spec dict, None when the flag is absent.
+    Malformed specs raise SystemExit with a usage line rather than
+    silently benching the wrong shape."""
+    argv = sys.argv[1:] if argv is None else argv
+    raw = None
+    for i, a in enumerate(argv):
+        if a == "--synth":
+            if i + 1 >= len(argv):
+                raise SystemExit("--synth needs rows=...,cols=...")
+            raw = argv[i + 1]
+            break
+        if a.startswith("--synth="):
+            raw = a[len("--synth="):]
+            break
+    if raw is None:
+        return None
+    spec = {"rows": 0, "cols": 0, "chunk": 262144, "seed": 17}
+    for part in raw.split(","):
+        k, _, v = part.partition("=")
+        if k not in spec or not v:
+            raise SystemExit(f"--synth: bad field {part!r} "
+                             "(want rows=...,cols=...[,chunk=...][,seed=...])")
+        spec[k] = int(v)
+    if spec["rows"] < 1 or spec["cols"] < 1:
+        raise SystemExit("--synth: rows and cols must be >= 1")
+    return spec
+
+
+def _stream_bench(result, spec):
+    """Out-of-core ingest bench: stream `spec` rows of synthetic data
+    (helpers/synth.py — generated chunk-by-chunk, never materialized)
+    through the two-pass sketch+bin loader, then train a short booster
+    on the binned result. Records stream_* keys — chunk count, parse/
+    bin overlap fraction, end-to-end ingest rows/sec — in the same
+    JSON record. Best-effort like _serve_bench: a fault leaves zeros
+    and a stderr line. Runs only when --synth is given; the 1M-row
+    in-memory headline above is untouched."""
+    if spec is None:
+        return
+    try:
+        import lightgbm_tpu as lgb
+        from helpers.synth import SynthSource
+        src = SynthSource(rows=spec["rows"], cols=spec["cols"],
+                          chunk_rows=spec["chunk"], seed=spec["seed"])
+        t0 = time.perf_counter()
+        ds = lgb.Dataset(src, params={"max_bin": MAX_BIN}).construct()
+        ingest_s = time.perf_counter() - t0
+        st = ds._binned.stream_stats
+        result["stream_chunks"] = st.chunks
+        result["stream_rows"] = st.rows
+        result["stream_overlap_frac"] = round(st.overlap_frac, 4)
+        result["stream_rows_per_sec"] = round(st.rows_per_sec, 1)
+        result["stream_sample_rows"] = st.sample_rows
+        result["stream_exact"] = int(st.exact)
+        result["stream_ingest_s"] = round(ingest_s, 3)
+        n_trees = int(os.environ.get("BENCH_STREAM_TREES", 20))
+        t0 = time.perf_counter()
+        lgb.train(dict(PARAMS, objective="binary"), ds,
+                  num_boost_round=n_trees)
+        train_s = time.perf_counter() - t0
+        if train_s > 0:
+            result["stream_trees_per_sec"] = round(n_trees / train_s, 3)
+        print(f"# stream bench: {st.rows} rows / {st.chunks} chunks in "
+              f"{ingest_s:.1f}s ({st.rows_per_sec:.0f} rows/s, "
+              f"{st.overlap_frac:.0%} parse/bin overlap), "
+              f"{n_trees} trees in {train_s:.1f}s", file=sys.stderr)
+    except Exception as exc:
+        print(f"# stream bench failed: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
+
+
 def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     result = {"metric": "higgs1m_trees_per_sec", "value": 0.0,
@@ -371,7 +444,13 @@ def main():
               "device_peak_tflops": 0.0,
               # per-task rows (regression/multiclass/lambdarank) from
               # helpers/bench_tasks.py, filled by _task_bench
-              "tasks": []}
+              "tasks": [],
+              # out-of-core ingest schema (filled by _stream_bench when
+              # --synth rows=...,cols=... is given; zeros otherwise)
+              "stream_chunks": 0, "stream_rows": 0,
+              "stream_overlap_frac": 0.0, "stream_rows_per_sec": 0.0,
+              "stream_sample_rows": 0, "stream_exact": 0,
+              "stream_ingest_s": 0.0, "stream_trees_per_sec": 0.0}
     block_times = []
     block_trees = min(BLOCK_TREES, BENCH_TREES)
     bench = None
@@ -463,6 +542,7 @@ def main():
     _pipeline_bench(bench, result)
     _serve_bench(bench, result)
     _task_bench(result)
+    _stream_bench(result, _parse_synth_argv())
     try:
         # reliability counters (lightgbm_tpu/reliability/): how degraded
         # this record is — retries, fused->per-iter / device->host

@@ -1,0 +1,88 @@
+"""Deterministic chunked synthetic data: 100M-row runs from one seed.
+
+Counter-based generation (Philox) with a FIXED number of 64-bit draws
+per row, so chunk k's values depend only on (seed, absolute row index)
+— never on chunk size or iteration order. `synth_chunk(row0, n)` jumps
+the Philox counter straight to `row0 * draws_per_row` and draws exactly
+`n * draws_per_row` uniforms; any chunking of [0, N) therefore yields
+the byte-identical dataset (tests/test_streaming.py locks this).
+
+Normals come from Box-Muller on uniform pairs — fixed two draws per
+normal. NumPy's `standard_normal` uses ziggurat rejection sampling with
+data-dependent draw consumption, which would break the row->counter
+alignment; don't substitute it.
+
+The feature/label rule mirrors bench.py's `make_higgs_like` (a few
+"physics" features + noise dims, roughly balanced binary labels), so
+`bench.py --synth rows=...,cols=...` benches the same problem shape at
+out-of-core scale without ever materializing the matrix.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from lightgbm_tpu.streaming import ChunkSource
+
+__all__ = ["SynthSource", "synth_chunk", "draws_per_row"]
+
+
+def draws_per_row(cols: int) -> int:
+    """Fixed 64-bit draw budget per row: a Box-Muller pair per feature
+    plus one pair for the label-noise normal, padded up to a multiple
+    of 4 because Philox `advance(delta)` skips whole counter blocks of
+    four 64-bit outputs — a row boundary must land on a block boundary
+    for the counter jump to be expressible."""
+    need = 2 * int(cols) + 2
+    return (need + 3) // 4 * 4
+
+
+def synth_chunk(row0: int, n: int, cols: int, seed: int = 17):
+    """Rows [row0, row0 + n) of the (seed, cols) dataset:
+    (X float32 [n, cols], y float32 [n])."""
+    dpr = draws_per_row(cols)
+    bg = np.random.Philox(key=np.uint64(seed))
+    bg.advance(int(row0) * (dpr // 4))
+    u = np.random.Generator(bg).random((n, dpr), dtype=np.float64)
+    u = u[:, :2 * cols + 2]  # drop block-alignment padding
+    # Box-Muller: z_j from the uniform pair (u[2j], u[2j+1])
+    u1 = np.maximum(u[:, 0::2], np.finfo(np.float64).tiny)
+    u2 = u[:, 1::2]
+    z = np.sqrt(-2.0 * np.log(u1)) * np.cos(2.0 * np.pi * u2)
+    X = z[:, :cols].astype(np.float32)
+    noise = z[:, cols]
+
+    def c(i):
+        return X[:, i % cols].astype(np.float64)
+
+    logit = (1.2 * c(0) - 0.8 * c(1) + 0.6 * c(2) * c(3) +
+             0.5 * np.abs(c(4)) - 0.4 * c(5) ** 2 +
+             0.3 * c(6) * c(0) + 0.35 * noise)
+    # E[0.5|z|] - 0.4 E[z^2] ~ 0, so threshold 0 is ~balanced without
+    # needing the global median (which a stream cannot know chunk-wise)
+    y = (logit > 0.0).astype(np.float32)
+    return X, y
+
+
+class SynthSource(ChunkSource):
+    """ChunkSource over the synthetic dataset — nothing materialized
+    beyond one chunk; restartable at any chunk by counter jump."""
+
+    has_label = True
+
+    def __init__(self, rows: int, cols: int, chunk_rows: int = 65536,
+                 seed: int = 17):
+        super().__init__(chunk_rows)
+        self.num_rows = int(rows)
+        self.num_features = int(cols)
+        self.seed = int(seed)
+
+    def chunks(self, start_chunk: int = 0):
+        step = self.chunk_rows
+        for lo in range(start_chunk * step, self.num_rows, step):
+            n = min(step, self.num_rows - lo)
+            yield synth_chunk(lo, n, self.num_features, self.seed)
+
+    def describe(self) -> str:
+        return (f"synth[{self.num_rows}x{self.num_features} "
+                f"seed={self.seed}]")

@@ -31,6 +31,7 @@ DISPATCH_MANIFEST = (
     ("gbdt.py", "_grow", "collective_psum"),
     ("engine.py", "predict_raw", "serving_device_predict"),
     ("checkpoint.py", "save_checkpoint", "checkpoint_io"),
+    ("loader.py", "_ingest_chunk_step", "streaming_ingest"),
 )
 
 #: wrapper function -> the site its body injects
@@ -46,6 +47,7 @@ _DIR_HINTS = {
     ("checkpoint.py", "save_checkpoint"): "reliability",
     ("gbdt.py", "train_many_dispatch"): "boosting",
     ("gbdt.py", "_grow"): "boosting",
+    ("loader.py", "_ingest_chunk_step"): "streaming",
 }
 
 

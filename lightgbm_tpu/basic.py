@@ -113,6 +113,17 @@ def _to_2d_float(data) -> np.ndarray:
     return np.ascontiguousarray(arr, dtype=np.float64)
 
 
+def _numeric_2d_view(data) -> Optional[np.ndarray]:
+    """All-numeric input that can skip `_to_2d_float`'s full float64
+    copy: already a 2-D float ndarray (or memmap). Binning reads f32
+    natively (cext) and casts chunk-wise otherwise, so these route
+    through the streaming spine zero-copy (docs/Streaming.md)."""
+    if isinstance(data, np.ndarray) and data.ndim == 2 and \
+            data.dtype in (np.float32, np.float64) and data.shape[0] > 0:
+        return data
+    return None
+
+
 def _load_svmlight_or_csv(path: str) -> np.ndarray:
     """Minimal text loader: CSV/TSV with optional label in first column.
     (Reference Parser auto-detect, src/io/parser.cpp.)"""
@@ -274,14 +285,30 @@ class Dataset:
                 if self.free_raw_data:
                     self.data = None
                 return self
-            raw = _load_svmlight_or_csv(data)
-            if self.label is None:
-                self.label, raw = raw[:, 0], raw[:, 1:]
-            data = raw
-        chunked_in = _is_chunked(data)
+            if cfg.stream_input:
+                # out-of-core route: never materialize the text file —
+                # chunks stream through the two-pass loader instead
+                from .streaming import source_from_path
+                lc = 0
+                if cfg.label_column and \
+                        not cfg.label_column.startswith("name:"):
+                    lc = int(cfg.label_column)
+                data = source_from_path(
+                    data, chunk_rows=int(cfg.stream_chunk_rows),
+                    label_col=None if self.label is not None else lc,
+                    header=bool(cfg.header))
+            else:
+                raw = _load_svmlight_or_csv(data)
+                if self.label is None:
+                    self.label, raw = raw[:, 0], raw[:, 1:]
+                data = raw
+        from .streaming import ChunkSource
+        stream_src = data if isinstance(data, ChunkSource) else None
+        chunked_in = stream_src is None and _is_chunked(data)
         if chunked_in:
             data = [data] if isinstance(data, Sequence) else data
-        sparse_in = not chunked_in and _is_sparse(data)
+        sparse_in = stream_src is None and not chunked_in and \
+            _is_sparse(data)
         pandas_cat = None
         pandas_cat_idx: List[int] = []
         if chunked_in:
@@ -300,10 +327,18 @@ class Dataset:
             X, df_names, pandas_cat_idx, pandas_cat = \
                 _data_from_pandas(data, ref_pc)
             names_from_df = df_names
+        elif stream_src is not None:
+            X = stream_src
+            names_from_df = None
         else:
             # sparse stays sparse through binning (reference SparseBin /
             # __init_from_csr): only the uint8 bin matrix is densified
-            X = data if sparse_in else _to_2d_float(data)
+            if sparse_in:
+                X = data
+            else:
+                X = None if cfg.linear_tree else _numeric_2d_view(data)
+                if X is None:
+                    X = _to_2d_float(data)
             names_from_df = None
         names: Optional[List[str]] = None
         if self.feature_name != "auto" and self.feature_name is not None:
@@ -325,6 +360,21 @@ class Dataset:
                    if c != ""]
         elif pandas_cat_idx:
             cat = list(pandas_cat_idx)  # 'auto': category-dtype columns
+        if stream_src is None and not chunked_in and not sparse_in and \
+                not cfg.linear_tree and _numeric_2d_view(X) is not None:
+            # all-numeric in-memory input rides the same ChunkSource
+            # spine as disk streams — zero-copy row slices instead of a
+            # separate whole-matrix float64 copy path
+            from .streaming import ArraySource
+            stream_src = ArraySource(X,
+                                     chunk_rows=int(cfg.stream_chunk_rows))
+        if stream_src is not None:
+            self._binned = self._construct_streamed(
+                stream_src, cfg, cat, names)
+            self._binned.pandas_categorical = pandas_cat
+            if self.free_raw_data:
+                self.data = None
+            return self
         construct_binned = (
             BinnedDataset.from_chunks if chunked_in
             else BinnedDataset.from_sparse if sparse_in
@@ -380,6 +430,52 @@ class Dataset:
         if self.free_raw_data:
             self.data = None
         return self
+
+    def _construct_streamed(self, source, cfg, cat, names):
+        """Two-pass construction over a ChunkSource (streaming/loader):
+        the out-of-core route for disk streams and the zero-copy route
+        for in-memory numeric arrays. Covering sketches reproduce the
+        in-memory bin mappers bit-for-bit (docs/Streaming.md)."""
+        from .streaming import build_streamed_dataset
+        kwargs = dict(
+            label=None if self.label is None else
+            np.asarray(self.label, dtype=np.float32).reshape(-1),
+            weight=None if self.weight is None else
+            np.asarray(self.weight, np.float32),
+            group=None if self.group is None else np.asarray(self.group),
+            init_score=None if self.init_score is None else
+            np.asarray(self.init_score),
+            max_bin=cfg.max_bin, min_data_in_bin=cfg.min_data_in_bin,
+            sample_cnt=cfg.bin_construct_sample_cnt,
+            use_missing=cfg.use_missing,
+            zero_as_missing=cfg.zero_as_missing,
+            categorical_features=cat, seed=cfg.data_random_seed,
+            feature_names=names,
+            sample_rows=int(cfg.stream_sample_rows),
+            bin_parity=bool(cfg.stream_bin_parity))
+        if self.reference is not None:
+            # align: valid sets reuse the training BinMappers and bin
+            # exactly its used columns (reference
+            # LoadFromFileAlignWithOtherDataset, dataset_loader.cpp:299)
+            self.reference.construct()
+            ref = self.reference._binned
+            full = [None] * ref.num_total_features
+            for j, f in enumerate(ref.used_features):
+                full[int(f)] = ref.mappers[j]
+            trivial = BinMapper()
+            ref_mappers = [m if m is not None else trivial for m in full]
+            return build_streamed_dataset(
+                source, mappers=ref_mappers, feature_pre_filter=False,
+                used_override=np.asarray(ref.used_features, np.int32),
+                **kwargs)
+        dist = None
+        if source.array is not None:
+            dist = _distributed_bin_mappers(source.array, cfg, cat, False)
+        return build_streamed_dataset(
+            source, mappers=dist,
+            feature_pre_filter=cfg.feature_pre_filter,
+            pre_filter_with_mappers=dist is not None,
+            checkpoint_dir=cfg.checkpoint_dir or None, **kwargs)
 
     # ------------------------------------------------------------------
     def num_data(self) -> int:

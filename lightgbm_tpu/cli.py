@@ -150,6 +150,22 @@ class Application:
         from .data import BinnedDataset
         if BinnedDataset.is_binary_file(cfg.data):
             return Dataset(cfg.data, params=dict(self.params))
+        if cfg.stream_input:
+            # out-of-core ingestion (docs/Streaming.md): the text/npy
+            # file is never materialized — Dataset.construct streams it
+            # through the two-pass loader. Row partitioning happens at
+            # file granularity (pre_partition), so the shared-file
+            # auto-split path falls back to in-memory loading.
+            if cfg.num_machines > 1 and not cfg.pre_partition:
+                Log.warning(
+                    "stream_input with num_machines > 1 requires "
+                    "pre_partition=true (each machine streams its own "
+                    "file); falling back to in-memory loading")
+            else:
+                return Dataset(
+                    cfg.data, group=_maybe_load_group(cfg.data),
+                    weight=_maybe_load_weight(cfg.data),
+                    params=dict(self.params))
         X, y = _load_text_data(cfg.data, cfg)
         group = _maybe_load_group(cfg.data)
         weight = _maybe_load_weight(cfg.data)
@@ -193,10 +209,17 @@ class Application:
         valid_sets, valid_names = [], []
         if cfg.valid:
             for i, vpath in enumerate(str(cfg.valid).split(",")):
-                vX, vy = _load_text_data(vpath, cfg)
                 vgroup = _maybe_load_group(vpath)
-                valid_sets.append(Dataset(vX, label=vy, group=vgroup,
-                                          reference=dtrain))
+                if cfg.stream_input:
+                    # stream the valid file too, aligned with the
+                    # training dataset's frozen bin mappers
+                    valid_sets.append(Dataset(vpath, group=vgroup,
+                                              reference=dtrain,
+                                              params=dict(self.params)))
+                else:
+                    vX, vy = _load_text_data(vpath, cfg)
+                    valid_sets.append(Dataset(vX, label=vy, group=vgroup,
+                                              reference=dtrain))
                 valid_names.append(f"valid_{i + 1}")
         callbacks = [log_evaluation(cfg.metric_freq)]
         if cfg.snapshot_freq > 0:
@@ -243,6 +266,12 @@ class Application:
         finally:
             if msrv is not None:
                 msrv.close()
+        st = getattr(getattr(dtrain, "_binned", None), "stream_stats", None)
+        if st is not None and st.chunks and cfg.stream_input:
+            Log.info("streamed ingest: %d chunks / %d rows, %.1f%% "
+                     "parse/bin overlap, %.0f rows/s",
+                     st.chunks, st.rows, 100.0 * st.overlap_frac,
+                     st.rows_per_sec)
         stats = getattr(getattr(booster, "gbdt", None),
                         "_pipeline_stats", None)
         if stats is not None and stats.blocks:

@@ -404,6 +404,32 @@ _PARAMS: List[ParamSpec] = [
     _p("pipeline_max_block", int, 200, (), lambda v: v >= 1,
        "upper bound on the adaptive scheduler's block size, whatever "
        "the measured rate suggests"),
+    _p("stream_input", bool, False, ("streaming_input",),
+       desc="two-pass out-of-core ingestion (docs/Streaming.md): pass 1 "
+            "streams chunks from the source into a per-feature reservoir "
+            "sketch that freezes the bin boundaries, pass 2 re-streams "
+            "and quantizes each chunk into the bin matrix, overlapping "
+            "the next chunk's parse with the current chunk's binning. "
+            "The raw [N, F] float matrix never materializes: peak host "
+            "memory is one chunk + the sketch + the uint8/16 bin matrix. "
+            "On the CLI, task=train data=<file.csv|.npy> streams the "
+            "file instead of loading it"),
+    _p("stream_chunk_rows", int, 65536, ("stream_batch_rows",),
+       lambda v: v >= 1,
+       "rows per streamed chunk: the unit of parse/bin overlap and the "
+       "peak raw-row materialization during ingestion"),
+    _p("stream_sample_rows", int, 200000, ("stream_sketch_rows",),
+       lambda v: v >= 1,
+       "capacity of the pass-1 reservoir sketch (rows). When it covers "
+       "the whole stream the sketch holds every row in order and the "
+       "frozen boundaries are bit-identical to in-memory binning; below "
+       "that, boundaries come from a uniform row sample "
+       "(docs/Streaming.md error envelope)"),
+    _p("stream_bin_parity", bool, False, (),
+       desc="require exact-parity streamed binning: fail ingestion if "
+            "the reservoir sample did not cover every row (i.e. "
+            "stream_sample_rows < N), instead of silently accepting "
+            "sample-based boundaries"),
 ]
 
 _SPEC_BY_NAME: Dict[str, ParamSpec] = {p.name: p for p in _PARAMS}

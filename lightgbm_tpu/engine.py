@@ -42,6 +42,15 @@ def train(params: Dict[str, Any], train_set: Dataset,
           resume_from: Optional[str] = None) -> Booster:
     params = copy.deepcopy(params or {})
     num_boost_round = _resolve_num_boost_round(params, num_boost_round)
+    from .streaming import ChunkSource
+    if isinstance(train_set, ChunkSource):
+        # out-of-core source handed straight to train(): wrap it so
+        # Dataset.construct routes through the two-pass streaming loader
+        train_set = Dataset(train_set, params=dict(params))
+    if valid_sets is not None:
+        vs = valid_sets if isinstance(valid_sets, list) else [valid_sets]
+        valid_sets = [Dataset(v, reference=train_set, params=dict(params))
+                      if isinstance(v, ChunkSource) else v for v in vs]
     resume_state = None
     if resume_from is not None:
         # kill-and-resume (docs/Reliability.md): restore the exact

@@ -54,6 +54,11 @@ class ObservabilityRegistry:
         # of the block walls the overlapped host work covered
         self._pipeline = {"blocks": 0, "iterations": 0,
                           "host_seconds": 0.0, "wall_seconds": 0.0}
+        # streamed-ingestion aggregates (streaming/loader.py): chunk and
+        # byte volume per pass plus the frozen sketch sample size
+        self._streaming = {"chunks": 0, "rows": 0, "bytes": 0,
+                           "wall_seconds": 0.0, "sample_rows": 0,
+                           "exact": 0}
         # histogram-backend resolution (boosting/gbdt.py
         # _resolved_hist_backend): the pinned choice + autotune timings
         self._hist_backend = {"choice": "", "autotuned": False,
@@ -92,6 +97,9 @@ class ObservabilityRegistry:
         with self._lock:
             self._pipeline = {"blocks": 0, "iterations": 0,
                               "host_seconds": 0.0, "wall_seconds": 0.0}
+            self._streaming = {"chunks": 0, "rows": 0, "bytes": 0,
+                               "wall_seconds": 0.0, "sample_rows": 0,
+                               "exact": 0}
             self._hist_backend = {"choice": "", "autotuned": False,
                                   "timings_ms": {}}
 
@@ -105,6 +113,16 @@ class ObservabilityRegistry:
                 "host_seconds": round(p["host_seconds"], 6),
                 "wall_seconds": round(p["wall_seconds"], 6),
                 "overlap_frac": round(frac, 4)}
+
+    def streaming_snapshot(self) -> Dict:
+        with self._lock:
+            s = dict(self._streaming)
+        rps = s["rows"] / s["wall_seconds"] if s["wall_seconds"] > 0 else 0.0
+        return {"chunks": s["chunks"], "rows": s["rows"],
+                "bytes": s["bytes"], "sample_rows": s["sample_rows"],
+                "exact": s["exact"],
+                "wall_seconds": round(s["wall_seconds"], 6),
+                "rows_per_sec": round(rps, 1)}
 
     def hist_backend_snapshot(self) -> Dict:
         """The pinned histogram backend as a flat exportable mapping.
@@ -126,6 +144,7 @@ class ObservabilityRegistry:
             "enabled": self.enabled,
             "hist_backend": self.hist_backend_snapshot(),
             "pipeline": self.pipeline_snapshot(),
+            "streaming": self.streaming_snapshot(),
             "training": self.training.snapshot(),
             "compiles": {"entries": self.compiles.snapshot(),
                          **self.compiles.totals()},
@@ -150,6 +169,7 @@ class ObservabilityRegistry:
             (snap["counters"], "lightgbm_tpu_reliability", None),
             (snap["hist_backend"], "lightgbm_tpu_hist_backend", None),
             (snap["pipeline"], "lightgbm_tpu_pipeline", None),
+            (snap["streaming"], "lightgbm_tpu_streaming", None),
             (snap["timers"], "lightgbm_tpu_timer_seconds", None),
             (snap["trace"], "lightgbm_tpu_trace", None),
         ])
@@ -274,6 +294,35 @@ class ObservabilityRegistry:
                        iterations=int(k),
                        host_ms=round(float(host_s) * 1e3, 3),
                        overlap_frac=round(float(overlap_frac), 4))
+
+    def record_streaming_chunk(self, phase: str, chunk_index: int,
+                               t0: float, wall_s: float, rows: int,
+                               nbytes: int) -> None:
+        """One ingested chunk from streaming/loader.py: `phase` is
+        "sketch" (pass 1) or "bin" (pass 2); wall_s covers the chunk's
+        host work including any overlapped parse it absorbed."""
+        if not self.enabled:
+            return
+        with self._lock:
+            s = self._streaming
+            s["chunks"] += 1
+            if phase == "bin":   # pass 2 re-streams the same rows
+                s["rows"] += int(rows)
+            s["bytes"] += int(nbytes)
+            s["wall_seconds"] += float(wall_s)
+        self.trace.add("streaming_chunk", t0, wall_s, phase=str(phase),
+                       chunk=int(chunk_index), rows=int(rows),
+                       bytes=int(nbytes))
+
+    def record_streaming_sketch(self, sample_rows: int,
+                                exact: bool) -> None:
+        """The frozen pass-1 reservoir: its row count and whether it
+        held the whole stream (exact => bit-parity boundaries)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._streaming["sample_rows"] = int(sample_rows)
+            self._streaming["exact"] = int(bool(exact))
 
 
 #: process-global singleton; `lightgbm_tpu.observability.registry`.

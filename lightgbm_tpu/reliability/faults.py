@@ -21,6 +21,8 @@ site                        raised from
 ``collective_psum``         parallel dispatch boundary before sharded growth
 ``serving_device_predict``  serving BucketedPredictor.predict_raw
 ``checkpoint_io``           reliability.checkpoint bundle writes
+``streaming_ingest``        streaming.loader per-chunk ingest step (both
+                            passes), before sketch/bin work on the chunk
 ==========================  ==================================================
 
 All injection is host-side, at dispatch boundaries: raising inside
@@ -44,6 +46,7 @@ KNOWN_SITES = (
     "collective_psum",
     "serving_device_predict",
     "checkpoint_io",
+    "streaming_ingest",
 )
 
 

@@ -1,0 +1,27 @@
+"""Out-of-core streaming ingestion (docs/Streaming.md).
+
+Two-pass construction for datasets larger than host memory (Histogram
+Sort with Sampling, arXiv:1803.01237): pass 1 streams row chunks from a
+`ChunkSource` into a per-feature `ReservoirSketch` that freezes the bin
+boundaries from a bounded uniform row sample; pass 2 re-streams and
+quantizes each chunk straight into the preallocated uint8/16 bin
+matrix, double-buffering the next chunk's host parse against the
+current chunk's binning (the ingestion analogue of the pipeline
+executor's dispatch/finalize overlap).
+
+When the sketch capacity covers the whole stream
+(`stream_sample_rows >= N`) the sample IS the dataset in stream order
+and the frozen boundaries — and therefore the trained model — are
+byte-identical to the in-memory path.
+"""
+
+from .loader import StreamStats, build_streamed_dataset
+from .sketch import ReservoirSketch
+from .sources import (ArraySource, ChunkSource, CSVSource, NpySource,
+                      ParquetSource, source_from_path)
+
+__all__ = [
+    "ArraySource", "ChunkSource", "CSVSource", "NpySource",
+    "ParquetSource", "ReservoirSketch", "StreamStats",
+    "build_streamed_dataset", "source_from_path",
+]

@@ -1,0 +1,162 @@
+"""Row-aligned reservoir sketch for streamed quantile binning.
+
+The pass-1 statistic behind out-of-core bin finding (Histogram Sort
+with Sampling, arXiv:1803.01237): a uniform row sample of bounded size
+from which `binning.find_bin_mappers` derives the frozen boundaries.
+The sketch is ROW-aligned (one reservoir of whole rows, not per-feature
+value reservoirs) for two reasons:
+
+- exact-path parity: while fewer rows than `capacity` have been seen,
+  the buffer holds every row in stream order, so a covering sketch
+  feeds `find_bin_mappers` the very matrix the in-memory path would —
+  boundaries, and hence the trained model, are bit-identical
+  (tests/test_streaming.py locks this);
+- cross-feature consistency: row sampling keeps implicit-zero counts
+  and NaN rates consistent across features the way the reference's
+  sampled FindBin does (dataset_loader.cpp two-round sampling), which
+  per-feature value sketches do not.
+
+Beyond capacity it runs vectorized Algorithm R: row t (0-based) is kept
+with probability capacity/(t+1), replacing a uniformly random slot.
+`merge` concatenates while the union still fits (exactness preserved);
+two overflowing sketches merge by count-weighted subsampling — the
+per-host combine step distributed binning will reuse.
+
+The full state serializes to plain arrays (`state_dict`/`from_state`)
+so a mid-stream checkpoint (reliability/) can resume pass 1 with the
+identical RNG stream and buffer.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["ReservoirSketch"]
+
+
+class ReservoirSketch:
+    """Uniform row reservoir over a feature stream (Algorithm R)."""
+
+    def __init__(self, num_features: int, capacity: int, seed: int = 1):
+        if capacity < 1:
+            raise ValueError("sketch capacity must be >= 1")
+        self.num_features = int(num_features)
+        self.capacity = int(capacity)
+        self.rows_seen = 0
+        # allocated lazily and grown geometrically toward capacity, so a
+        # covering sketch over a small stream never allocates
+        # capacity x F up front
+        self._buf: Optional[np.ndarray] = None
+        self._rng = np.random.RandomState(seed)
+
+    # ---- ingest -------------------------------------------------------
+    def _ensure(self, rows_needed: int) -> None:
+        need = min(self.capacity, rows_needed)
+        if self._buf is None:
+            cap0 = min(self.capacity, max(need, 1024))
+            self._buf = np.empty((cap0, self.num_features), np.float64)
+        elif self._buf.shape[0] < need:
+            grown = min(self.capacity, max(need, 2 * self._buf.shape[0]))
+            self._buf = np.resize(self._buf, (grown, self.num_features))
+
+    def add_chunk(self, X: np.ndarray) -> None:
+        """Feed a [n, F] row chunk (any float dtype; cast is exact)."""
+        X = np.asarray(X)
+        if X.ndim != 2 or X.shape[1] != self.num_features:
+            raise ValueError(
+                f"chunk shape {X.shape} does not match "
+                f"num_features={self.num_features}")
+        n = X.shape[0]
+        if n == 0:
+            return
+        fill = min(max(self.capacity - self.rows_seen, 0), n)
+        if fill:
+            self._ensure(self.rows_seen + fill)
+            self._buf[self.rows_seen:self.rows_seen + fill] = X[:fill]
+        if fill < n:
+            # Algorithm R over the overflow rows: global index t keeps
+            # with prob capacity/(t+1) into slot j ~ U[0, t]. Draws are
+            # vectorized; the (few) accepted rows replay in stream order
+            # so later acceptances overwrite earlier ones exactly as the
+            # sequential algorithm would.
+            t = self.rows_seen + fill + np.arange(n - fill, dtype=np.int64)
+            slots = (self._rng.random_sample(n - fill) * (t + 1)).astype(
+                np.int64)
+            hit = np.nonzero(slots < self.capacity)[0]
+            for i in hit:
+                self._buf[slots[i]] = X[fill + int(i)]
+        self.rows_seen += n
+
+    # ---- combine ------------------------------------------------------
+    @property
+    def sample_rows(self) -> int:
+        return min(self.rows_seen, self.capacity)
+
+    @property
+    def is_exact(self) -> bool:
+        """True while the buffer holds every row seen, in stream order."""
+        return self.rows_seen <= self.capacity
+
+    def sample(self) -> np.ndarray:
+        """The current [sample_rows, F] float64 sample (a view)."""
+        if self._buf is None:
+            return np.empty((0, self.num_features), np.float64)
+        return self._buf[:self.sample_rows]
+
+    def merge(self, other: "ReservoirSketch") -> "ReservoirSketch":
+        """Fold `other` into self (per-chunk / per-host combine).
+
+        While the union fits the capacity the merge is plain
+        concatenation — exactness (and therefore in-memory parity) is
+        preserved. Overflowing merges draw a count-weighted subsample of
+        the two buffers, which keeps the union a uniform row sample of
+        the combined stream."""
+        if other.num_features != self.num_features:
+            raise ValueError("cannot merge sketches over different "
+                             "feature counts")
+        total = self.rows_seen + other.rows_seen
+        if total <= self.capacity:
+            self._ensure(total)
+            self._buf[self.rows_seen:total] = other.sample()
+            self.rows_seen = total
+            return self
+        a, b = self.sample(), other.sample()
+        take_b = int(round(self.capacity * other.rows_seen / total))
+        take_b = min(take_b, len(b))
+        take_a = min(self.capacity - take_b, len(a))
+        ia = self._rng.choice(len(a), size=take_a, replace=False) \
+            if take_a < len(a) else np.arange(len(a))
+        ib = self._rng.choice(len(b), size=take_b, replace=False) \
+            if take_b < len(b) else np.arange(len(b))
+        merged = np.concatenate([a[np.sort(ia)], b[np.sort(ib)]], axis=0)
+        self._buf = np.ascontiguousarray(merged, np.float64)
+        self.rows_seen = total
+        return self
+
+    # ---- checkpoint ---------------------------------------------------
+    def state_dict(self) -> Dict:
+        s0, s1, s2, s3, s4 = self._rng.get_state()
+        return {
+            "num_features": np.int64(self.num_features),
+            "capacity": np.int64(self.capacity),
+            "rows_seen": np.int64(self.rows_seen),
+            "buf": self.sample().copy(),
+            "rng_keys": np.asarray(s1, np.uint32),
+            "rng_pos": np.asarray([s2, s3, s4], np.float64),
+        }
+
+    @staticmethod
+    def from_state(state: Dict) -> "ReservoirSketch":
+        sk = ReservoirSketch(int(state["num_features"]),
+                             int(state["capacity"]))
+        sk.rows_seen = int(state["rows_seen"])
+        buf = np.asarray(state["buf"], np.float64)
+        if len(buf):
+            sk._buf = np.ascontiguousarray(buf)
+        pos = np.asarray(state["rng_pos"])
+        sk._rng.set_state(("MT19937",
+                           np.asarray(state["rng_keys"], np.uint32),
+                           int(pos[0]), int(pos[1]), float(pos[2])))
+        return sk

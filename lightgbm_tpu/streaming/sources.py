@@ -1,0 +1,233 @@
+"""Restartable row-chunk sources behind one `ChunkSource` protocol.
+
+Every out-of-core input — CSV text, `.npy` memmap, Parquet (optional,
+gated on pyarrow), an in-memory array, or a synthetic generator
+(helpers/synth.py) — yields `[n, F]` row chunks through the same
+iterator protocol, so the two-pass loader (loader.py) and the
+in-memory fast path share one ingestion spine. A source must be
+restartable: `chunks(start_chunk=k)` begins a fresh pass at chunk k,
+which is what mid-stream checkpoint resume replays from.
+
+Array-backed sources additionally expose `.array` (the zero-copy
+random-access matrix) so bin finding can sample rows directly instead
+of running a sketch pass — the route the in-memory NumPy path takes
+(no whole-matrix float64 copy, satellite of docs/Streaming.md).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from ..utils.file_io import open_file
+
+__all__ = ["ChunkSource", "ArraySource", "CSVSource", "NpySource",
+           "ParquetSource", "source_from_path"]
+
+#: a pass yields (X_chunk [n, F] ndarray, y_chunk [n] or None)
+Chunk = Tuple[np.ndarray, Optional[np.ndarray]]
+
+
+class ChunkSource:
+    """Restartable iterator of `[n, F]` row chunks.
+
+    Subclasses implement `chunks(start_chunk)` and set `chunk_rows`.
+    `num_rows`/`num_features` may be None for unsized sources (CSV)
+    until a full pass has completed; the loader's pass 1 fills them in.
+    `has_label` marks sources that carry the target inside the stream
+    (CSV label column, synthetic generators)."""
+
+    chunk_rows: int = 65536
+    has_label: bool = False
+    #: zero-copy random-access matrix when one exists (ArraySource,
+    #: NpySource memmap); None for pure streams
+    array: Optional[np.ndarray] = None
+
+    def __init__(self, chunk_rows: int = 65536):
+        if chunk_rows < 1:
+            raise ValueError("chunk_rows must be >= 1")
+        self.chunk_rows = int(chunk_rows)
+        self.num_rows: Optional[int] = None
+        self.num_features: Optional[int] = None
+
+    def chunks(self, start_chunk: int = 0) -> Iterator[Chunk]:
+        raise NotImplementedError  # pragma: no cover - interface
+
+    def describe(self) -> str:
+        return type(self).__name__
+
+
+class ArraySource(ChunkSource):
+    """Chunk view over an in-memory array or memmap — ZERO copy: each
+    chunk is a slice of the underlying matrix, and `.array` lets bin
+    finding sample rows directly. This is how all-numeric NumPy input
+    rides the streaming spine without the legacy whole-matrix float64
+    conversion."""
+
+    def __init__(self, X: np.ndarray, chunk_rows: int = 65536,
+                 label: Optional[np.ndarray] = None):
+        super().__init__(chunk_rows)
+        if X.ndim != 2:
+            raise ValueError("ArraySource needs a 2-D matrix")
+        self.array = X
+        self.num_rows = int(X.shape[0])
+        self.num_features = int(X.shape[1])
+        self._label = label
+        self.has_label = label is not None
+
+    def chunks(self, start_chunk: int = 0) -> Iterator[Chunk]:
+        step = self.chunk_rows
+        for lo in range(start_chunk * step, self.num_rows, step):
+            hi = min(lo + step, self.num_rows)
+            y = None if self._label is None else self._label[lo:hi]
+            yield self.array[lo:hi], y
+
+    def describe(self) -> str:
+        return (f"array[{self.num_rows}x{self.num_features} "
+                f"{self.array.dtype}]")
+
+
+class NpySource(ArraySource):
+    """`.npy` file opened with mmap_mode='r': chunks fault in one
+    window of pages at a time, so peak resident raw data stays one
+    chunk regardless of file size."""
+
+    def __init__(self, path: str, chunk_rows: int = 65536):
+        X = np.load(path, mmap_mode="r")
+        if X.ndim != 2:
+            raise ValueError(f"{path}: expected a 2-D .npy matrix, got "
+                             f"shape {X.shape}")
+        super().__init__(X, chunk_rows)
+        self.path = path
+
+    def describe(self) -> str:
+        return f"npy:{os.path.basename(self.path)}[{self.num_rows}]"
+
+
+class CSVSource(ChunkSource):
+    """Streamed CSV/TSV: reads `chunk_rows` lines at a time and parses
+    them with np.loadtxt — the raw text and the parsed float block both
+    stay chunk-sized. `label_col` (usually 0, the reference's
+    label_column default) is split out of the feature block; None means
+    the file carries features only."""
+
+    def __init__(self, path: str, chunk_rows: int = 65536,
+                 label_col: Optional[int] = 0, header: bool = False,
+                 delimiter: Optional[str] = None):
+        super().__init__(chunk_rows)
+        self.path = path
+        self.label_col = label_col
+        self.header = bool(header)
+        self.has_label = label_col is not None
+        if delimiter is None:
+            with open_file(path) as fh:
+                if self.header:
+                    fh.readline()
+                first = fh.readline()
+            delimiter = "\t" if "\t" in first else ","
+        self.delimiter = delimiter
+
+    def _parse_block(self, lines) -> Chunk:
+        block = np.loadtxt(io.StringIO("".join(lines)),
+                           delimiter=self.delimiter, ndmin=2)
+        y = None
+        if self.label_col is not None:
+            y = block[:, self.label_col].astype(np.float32)
+            block = np.delete(block, self.label_col, axis=1)
+        if self.num_features is None:
+            self.num_features = block.shape[1]
+        return block, y
+
+    def chunks(self, start_chunk: int = 0) -> Iterator[Chunk]:
+        rows = 0
+        with open_file(self.path) as fh:
+            if self.header:
+                fh.readline()
+            skip = start_chunk * self.chunk_rows
+            lines = []
+            for line in fh:
+                if not line.strip():
+                    continue
+                if skip > 0:
+                    # resume cursor: chunk boundaries are line-counted,
+                    # so skipping re-reads text but parses nothing
+                    skip -= 1
+                    rows += 1
+                    continue
+                lines.append(line)
+                if len(lines) == self.chunk_rows:
+                    rows += len(lines)
+                    yield self._parse_block(lines)
+                    lines = []
+            if lines:
+                rows += len(lines)
+                yield self._parse_block(lines)
+        if start_chunk == 0:
+            self.num_rows = rows
+
+    def describe(self) -> str:
+        return f"csv:{os.path.basename(self.path)}"
+
+
+class ParquetSource(ChunkSource):
+    """Parquet via pyarrow, OPTIONAL: constructing one without pyarrow
+    installed raises a clear error instead of importing at module load
+    (the container does not ship pyarrow; nothing may pip install)."""
+
+    def __init__(self, path: str, chunk_rows: int = 65536,
+                 label_col: Optional[str] = None):
+        super().__init__(chunk_rows)
+        try:
+            import pyarrow.parquet as pq  # noqa: F401
+        except ImportError as exc:
+            raise ImportError(
+                "ParquetSource requires pyarrow, which is not installed; "
+                "convert the file to .npy or CSV, or install pyarrow"
+            ) from exc
+        self.path = path
+        self.label_col = label_col
+        self.has_label = label_col is not None
+        import pyarrow.parquet as pq
+        meta = pq.ParquetFile(path)
+        self.num_rows = int(meta.metadata.num_rows)
+        names = list(meta.schema_arrow.names)
+        self.num_features = len(names) - (1 if label_col in names else 0)
+
+    def chunks(self, start_chunk: int = 0) -> Iterator[Chunk]:
+        import pyarrow.parquet as pq
+        pf = pq.ParquetFile(self.path)
+        ci = 0
+        for batch in pf.iter_batches(batch_size=self.chunk_rows):
+            if ci < start_chunk:
+                ci += 1
+                continue
+            ci += 1
+            cols = {n: np.asarray(batch.column(i))
+                    for i, n in enumerate(batch.schema.names)}
+            y = None
+            if self.label_col is not None and self.label_col in cols:
+                y = cols.pop(self.label_col).astype(np.float32)
+            X = np.column_stack(list(cols.values())).astype(
+                np.float64, copy=False)
+            yield X, y
+
+    def describe(self) -> str:
+        return f"parquet:{os.path.basename(self.path)}"
+
+
+def source_from_path(path: str, chunk_rows: int = 65536,
+                     label_col: Optional[int] = 0,
+                     header: bool = False) -> ChunkSource:
+    """Pick a source for a data path by extension: `.npy` memmap,
+    `.parquet`/`.pq` (pyarrow-gated), else delimited text."""
+    low = path.lower()
+    if low.endswith(".npy"):
+        return NpySource(path, chunk_rows)
+    if low.endswith((".parquet", ".pq")):
+        return ParquetSource(path, chunk_rows,
+                             label_col=None if label_col is None
+                             else "label")
+    return CSVSource(path, chunk_rows, label_col=label_col, header=header)
