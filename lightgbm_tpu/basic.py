@@ -166,30 +166,27 @@ def _sample_chunked_rows(chunks, take: int, seed: int) -> np.ndarray:
     return np.concatenate(parts, axis=0)
 
 
-def _distributed_bin_mappers(X, cfg, cat, sparse_in):
-    """Multi-machine bin finding: every rank contributes an equal-size
-    sample of its local rows via allgather, and all ranks derive
-    IDENTICAL BinMappers from the union — the TPU form of the
-    reference's per-rank FindBin + Allgather of serialized mappers
-    (dataset_loader.cpp:722-807). Returns None single-process."""
+def _multihost_process_count() -> int:
     import jax
     try:
-        if jax.process_count() <= 1:
-            return None
+        return jax.process_count()
     except RuntimeError:
-        return None
+        return 1
+
+
+def _allgather_find_mappers(sample, cfg, cat, sparse_in=False):
+    """Collective half of distributed bin finding: every rank ships an
+    equal-size subsample of its local `sample` rows via allgather and
+    all ranks derive IDENTICAL BinMappers from the union — the TPU form
+    of the reference's per-rank FindBin + Allgather of serialized
+    mappers (dataset_loader.cpp:722-807). Must be called by every rank
+    at the same program point."""
+    import jax
     from jax.experimental import multihost_utils
     from .binning import find_bin_mappers
     nproc = jax.process_count()
     per = max(1, cfg.bin_construct_sample_cnt // nproc)
-    chunked = not (hasattr(X, "shape") or _is_sparse(X))
-    if chunked:
-        # streamed input: sample rows out of the local chunk iterator and
-        # allgather exactly like the array path — the reference's
-        # distributed loader samples from any local iterator the same way
-        # (dataset_loader.cpp:722-807 sample-then-allgather)
-        X = _sample_chunked_rows(X, per, cfg.data_random_seed)
-    n_local = X.shape[0]
+    n_local = sample.shape[0]
     # variable-size sample gather with fixed wire shapes: every rank
     # ships `per` rows (zero-padded) plus its true count, and the
     # padding is stripped after the gather — the reference's
@@ -198,9 +195,9 @@ def _distributed_bin_mappers(X, cfg, cat, sparse_in):
     if n_local > n_samp:
         rng = np.random.RandomState(cfg.data_random_seed)
         idx = np.sort(rng.choice(n_local, size=n_samp, replace=False))
-        sample = X[idx]
+        sample = sample[idx]
     else:
-        sample = X[:n_samp]
+        sample = sample[:n_samp]
     if sparse_in:
         sample = sample.toarray()  # densify the sample rows only
     sample = np.ascontiguousarray(sample, dtype=np.float64)
@@ -216,6 +213,37 @@ def _distributed_bin_mappers(X, cfg, cat, sparse_in):
         sample_cnt=len(union), use_missing=cfg.use_missing,
         zero_as_missing=cfg.zero_as_missing, categorical_features=cat,
         seed=cfg.data_random_seed)
+
+
+def _distributed_bin_mappers(X, cfg, cat, sparse_in):
+    """Multi-machine bin finding over local random-access data: sample
+    locally, then `_allgather_find_mappers`. Returns None
+    single-process."""
+    if _multihost_process_count() <= 1:
+        return None
+    import jax
+    nproc = jax.process_count()
+    per = max(1, cfg.bin_construct_sample_cnt // nproc)
+    chunked = not (hasattr(X, "shape") or _is_sparse(X))
+    if chunked:
+        # streamed input: sample rows out of the local chunk iterator and
+        # allgather exactly like the array path — the reference's
+        # distributed loader samples from any local iterator the same way
+        # (dataset_loader.cpp:722-807 sample-then-allgather)
+        X = _sample_chunked_rows(X, per, cfg.data_random_seed)
+        sparse_in = False
+    return _allgather_find_mappers(X, cfg, cat, sparse_in)
+
+
+def _streaming_mapper_sync(cfg, cat):
+    """Multihost hook for pure streams (no random-access `.array`): the
+    loader hands each rank's pass-1 sketch sample to this closure, which
+    runs the same allgather the array path uses, so every rank freezes
+    IDENTICAL bin boundaries before the collective histogram psum.
+    Returns None single-process (the loader then bins locally)."""
+    if _multihost_process_count() <= 1:
+        return None
+    return lambda sample: _allgather_find_mappers(sample, cfg, cat)
 
 
 class Dataset:
@@ -289,10 +317,10 @@ class Dataset:
                 # out-of-core route: never materialize the text file —
                 # chunks stream through the two-pass loader instead
                 from .streaming import source_from_path
-                lc = 0
-                if cfg.label_column and \
-                        not cfg.label_column.startswith("name:"):
-                    lc = int(cfg.label_column)
+                # the raw label_column spec (index, digit string, or
+                # name:) resolves per source format inside
+                # source_from_path — Parquet maps it to a schema column
+                lc = cfg.label_column if cfg.label_column else 0
                 data = source_from_path(
                     data, chunk_rows=int(cfg.stream_chunk_rows),
                     label_col=None if self.label is not None else lc,
@@ -469,10 +497,16 @@ class Dataset:
                 used_override=np.asarray(ref.used_features, np.int32),
                 **kwargs)
         dist = None
+        sync = None
         if source.array is not None:
             dist = _distributed_bin_mappers(source.array, cfg, cat, False)
+        else:
+            # pure stream (no random-access matrix): the loader's pass-1
+            # sketch sample feeds this collective so every rank freezes
+            # identical boundaries; None single-process
+            sync = _streaming_mapper_sync(cfg, cat)
         return build_streamed_dataset(
-            source, mappers=dist,
+            source, mappers=dist, mapper_sync=sync,
             feature_pre_filter=cfg.feature_pre_filter,
             pre_filter_with_mappers=dist is not None,
             checkpoint_dir=cfg.checkpoint_dir or None, **kwargs)

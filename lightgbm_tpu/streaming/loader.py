@@ -32,7 +32,7 @@ import json
 import os
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -49,7 +49,13 @@ __all__ = ["StreamStats", "build_streamed_dataset"]
 
 _STATE_JSON = "stream_state.json"
 _STATE_NPZ = "stream_state.npz"
-_STATE_VERSION = 1
+_STATE_VERSION = 2
+#: pass-1 state saves are throttled: rewriting the sketch + labels is
+#: O(rows seen), so saving only after rows grow by this factor keeps
+#: total checkpoint I/O O(N) over the stream instead of O(N^2/chunk);
+#: a time floor bounds lost work on slow streams regardless
+_SAVE_GROWTH = 1.25
+_SAVE_INTERVAL_S = 30.0
 
 
 class StreamStats:
@@ -123,9 +129,16 @@ def _save_stream_state(ckpt_dir: str, state: Dict,
                        arrays: Dict[str, np.ndarray]) -> None:
     os.makedirs(ckpt_dir, exist_ok=True)
     jpath, npath = _state_paths(ckpt_dir)
+    # the npz carries a copy of the json cursor: the two files are
+    # renamed in separate os.replace calls, so a kill between them
+    # leaves a torn pair that load detects and discards instead of
+    # resuming with a cursor from chunk k over a sketch from chunk k+1
+    seq = np.asarray([int(state["next_chunk"]), int(state["rows"])],
+                     np.int64)
     tmp = npath + ".tmp"
     with open(tmp, "wb") as fh:
-        np.savez(fh, **{k: np.asarray(v) for k, v in arrays.items()})
+        np.savez(fh, _seq=seq,
+                 **{k: np.asarray(v) for k, v in arrays.items()})
     os.replace(tmp, npath)
     tmp = jpath + ".tmp"
     with open(tmp, "w") as fh:
@@ -146,6 +159,13 @@ def _load_stream_state(ckpt_dir: str):
         return None, None
     with np.load(npath) as z:
         arrays = {k: z[k] for k in z.files}
+    seq = arrays.pop("_seq", None)
+    if seq is None or int(seq[0]) != int(state["next_chunk"]) \
+            or int(seq[1]) != int(state["rows"]):
+        Log.warning(
+            "streaming: stream state json/npz pair is inconsistent "
+            "(torn save); discarding and restarting pass 1")
+        return None, None
     return state, arrays
 
 
@@ -175,6 +195,8 @@ def build_streamed_dataset(
         used_override: Optional[np.ndarray] = None,
         sample_rows: int = 200000,
         bin_parity: bool = False,
+        mapper_sync: Optional[Callable[[np.ndarray],
+                                       List[BinMapper]]] = None,
         checkpoint_dir: Optional[str] = None) -> BinnedDataset:
     """Construct a BinnedDataset from a ChunkSource in two passes.
 
@@ -184,9 +206,22 @@ def build_streamed_dataset(
     the covering case bit-identical. `sample_rows` caps the reservoir;
     `bin_parity=True` turns a non-covering sketch into a hard error
     instead of an approximation. `mappers`/`used_override` align the
-    result with a reference dataset's bins (validation sets). The
-    returned dataset carries `stream_stats`.
+    result with a reference dataset's bins (validation sets).
+    `mapper_sync`, when set (multihost pure streams), replaces the local
+    `find_bin_mappers` call: it receives the pass-1 sketch sample and
+    must return the mapper list every rank agrees on (a collective —
+    every rank reaches it exactly once per ingest). The returned
+    dataset carries `stream_stats`.
     """
+    if mapper_sync is not None and bin_parity:
+        # parity is a single-process guarantee; multihost boundaries
+        # come from the cross-host sample union, and letting the
+        # per-rank coverage check raise would strand peer ranks in the
+        # mapper collective — fail identically on every rank instead
+        raise LightGBMError(
+            "stream_bin_parity requires num_machines=1: multihost bin "
+            "boundaries come from the cross-host sample union, not the "
+            "local covering sketch")
     stats = StreamStats(source.describe())
     label_parts: List[np.ndarray] = []
     sk: Optional[ReservoirSketch] = None
@@ -199,6 +234,16 @@ def build_streamed_dataset(
     saved, saved_arrays = (None, None)
     if checkpoint_dir:
         saved, saved_arrays = _load_stream_state(checkpoint_dir)
+    if saved is not None and mapper_sync is not None \
+            and saved.get("phase") != "sketch":
+        # post-sketch state skips the mapper collective; a rank resuming
+        # past it while its peers enter it would hang the allgather, so
+        # multihost resume only trusts sketch-phase state (pass 1 then
+        # ends in the collective on every rank)
+        Log.warning("streaming: discarding post-sketch stream state "
+                    "under multihost — re-running pass 1 so the bin "
+                    "mapper collective runs on every rank")
+        saved, saved_arrays = None, None
     if saved is not None and source.array is None:
         num_features = int(saved["num_features"])
         num_rows = int(saved["rows"])
@@ -240,6 +285,8 @@ def build_streamed_dataset(
         rows_before = 0 if sk is None else num_rows
         counted = 0
         ci = start_chunk
+        next_save_rows = 0
+        last_save_t = time.monotonic()
         for X, y in source.chunks(start_chunk=start_chunk):
             t0 = time.perf_counter()
             _ingest_chunk_step(ci)
@@ -259,15 +306,23 @@ def build_streamed_dataset(
             if _obs.enabled:
                 _obs.record_streaming_chunk("sketch", ci - 1, t0, wall,
                                             X.shape[0], X.nbytes)
-            if checkpoint_dir:
+            rows_total = int((rows_before or 0) + counted)
+            # a save rewrites the whole sketch + label buffer (O(rows)),
+            # so only save after the stream grew by _SAVE_GROWTH (total
+            # I/O stays O(N)) or the time floor elapsed
+            if checkpoint_dir and (
+                    rows_total >= next_save_rows or
+                    time.monotonic() - last_save_t >= _SAVE_INTERVAL_S):
                 arrays = {"sk_" + k: v for k, v in sk.state_dict().items()}
                 arrays["labels"] = np.concatenate(label_parts) \
                     if label_parts else np.empty(0, np.float32)
                 _save_stream_state(checkpoint_dir, {
                     "phase": "sketch", "next_chunk": ci,
                     "num_features": int(num_features),
-                    "rows": int((rows_before or 0) + counted),
+                    "rows": rows_total,
                 }, arrays)
+                next_save_rows = int(rows_total * _SAVE_GROWTH) + 1
+                last_save_t = time.monotonic()
         if sk is None:
             raise LightGBMError("streaming: source yielded no chunks")
         num_rows = (rows_before or 0) + counted
@@ -284,15 +339,21 @@ def build_streamed_dataset(
                 f"streaming: sketch sampled {sk.sample_rows} of "
                 f"{sk.rows_seen} rows; bin boundaries are approximate "
                 "(raise stream_sample_rows for exact parity)")
-        # identical call to the in-memory path: with a covering sketch
-        # the sample IS the data in stream order, so boundaries (and the
-        # model) are bit-identical; non-covering, the reservoir stands
-        # in for the population
-        all_mappers = find_bin_mappers(
-            sk.sample(), max_bin=max_bin,
-            min_data_in_bin=min_data_in_bin, sample_cnt=sample_cnt,
-            use_missing=use_missing, zero_as_missing=zero_as_missing,
-            categorical_features=categorical_features, seed=seed)
+        if mapper_sync is not None:
+            # multihost: the collective derives one mapper list from
+            # every rank's sketch sample, so ranks streaming disjoint
+            # partitions still bin against identical boundaries
+            all_mappers = mapper_sync(sk.sample())
+        else:
+            # identical call to the in-memory path: with a covering
+            # sketch the sample IS the data in stream order, so
+            # boundaries (and the model) are bit-identical;
+            # non-covering, the reservoir stands in for the population
+            all_mappers = find_bin_mappers(
+                sk.sample(), max_bin=max_bin,
+                min_data_in_bin=min_data_in_bin, sample_cnt=sample_cnt,
+                use_missing=use_missing, zero_as_missing=zero_as_missing,
+                categorical_features=categorical_features, seed=seed)
         sk = None   # sketch buffer is dead weight from here on
         stats.pass1_s = time.perf_counter() - t_pass1
         if _obs.enabled:

@@ -175,10 +175,16 @@ class CSVSource(ChunkSource):
 class ParquetSource(ChunkSource):
     """Parquet via pyarrow, OPTIONAL: constructing one without pyarrow
     installed raises a clear error instead of importing at module load
-    (the container does not ship pyarrow; nothing may pip install)."""
+    (the container does not ship pyarrow; nothing may pip install).
+
+    `label_col` is the configured `label_column` spec: a column index
+    (int or digit string), a `name:<column>` reference, or a bare
+    column name. It resolves against the file schema at construction
+    — an absent column raises instead of silently training without
+    labels."""
 
     def __init__(self, path: str, chunk_rows: int = 65536,
-                 label_col: Optional[str] = None):
+                 label_col: Optional[object] = None):
         super().__init__(chunk_rows)
         try:
             import pyarrow.parquet as pq  # noqa: F401
@@ -188,13 +194,34 @@ class ParquetSource(ChunkSource):
                 "convert the file to .npy or CSV, or install pyarrow"
             ) from exc
         self.path = path
-        self.label_col = label_col
-        self.has_label = label_col is not None
         import pyarrow.parquet as pq
         meta = pq.ParquetFile(path)
         self.num_rows = int(meta.metadata.num_rows)
         names = list(meta.schema_arrow.names)
-        self.num_features = len(names) - (1 if label_col in names else 0)
+        self.label_col = self._resolve_label(label_col, names)
+        self.has_label = self.label_col is not None
+        self.num_features = len(names) - (1 if self.has_label else 0)
+
+    @staticmethod
+    def _resolve_label(spec, names) -> Optional[str]:
+        if spec is None:
+            return None
+        if isinstance(spec, str) and spec.startswith("name:"):
+            name = spec[len("name:"):]
+        elif isinstance(spec, str) and not spec.lstrip("-").isdigit():
+            name = spec
+        else:
+            idx = int(spec)
+            if not 0 <= idx < len(names):
+                raise ValueError(
+                    f"label_column index {idx} out of range for Parquet "
+                    f"schema with {len(names)} columns {names}")
+            name = names[idx]
+        if name not in names:
+            raise ValueError(
+                f"label column {name!r} not found in Parquet schema "
+                f"{names}; set label_column=name:<column> or an index")
+        return name
 
     def chunks(self, start_chunk: int = 0) -> Iterator[Chunk]:
         import pyarrow.parquet as pq
@@ -208,7 +235,11 @@ class ParquetSource(ChunkSource):
             cols = {n: np.asarray(batch.column(i))
                     for i, n in enumerate(batch.schema.names)}
             y = None
-            if self.label_col is not None and self.label_col in cols:
+            if self.label_col is not None:
+                if self.label_col not in cols:
+                    raise ValueError(
+                        f"{self.path}: batch schema lost label column "
+                        f"{self.label_col!r}")
                 y = cols.pop(self.label_col).astype(np.float32)
             X = np.column_stack(list(cols.values())).astype(
                 np.float64, copy=False)
@@ -219,15 +250,21 @@ class ParquetSource(ChunkSource):
 
 
 def source_from_path(path: str, chunk_rows: int = 65536,
-                     label_col: Optional[int] = 0,
+                     label_col: Optional[object] = 0,
                      header: bool = False) -> ChunkSource:
     """Pick a source for a data path by extension: `.npy` memmap,
-    `.parquet`/`.pq` (pyarrow-gated), else delimited text."""
+    `.parquet`/`.pq` (pyarrow-gated), else delimited text. `label_col`
+    is the raw `label_column` spec (index, digit string, or `name:`),
+    resolved per source format."""
     low = path.lower()
     if low.endswith(".npy"):
         return NpySource(path, chunk_rows)
     if low.endswith((".parquet", ".pq")):
-        return ParquetSource(path, chunk_rows,
-                             label_col=None if label_col is None
-                             else "label")
+        return ParquetSource(path, chunk_rows, label_col=label_col)
+    if isinstance(label_col, str):
+        if label_col.startswith("name:"):
+            raise ValueError(
+                "label_column=name: requires header parsing, which text "
+                "sources do not do; use a column index")
+        label_col = int(label_col)
     return CSVSource(path, chunk_rows, label_col=label_col, header=header)

@@ -149,7 +149,44 @@ class TestSources:
             np.concatenate([c[0] for c in src.chunks()]), X)
 
     def test_parquet_gated(self, tmp_path):
-        pytest.importorskip("pyarrow", reason="pyarrow not installed")
+        pa = pytest.importorskip("pyarrow", reason="pyarrow not installed")
+        import pyarrow.parquet as pq
+        rng = np.random.RandomState(0)
+        X = rng.randn(100, 3)
+        y = (rng.rand(100) > 0.5).astype(np.float32)
+        t = pa.table({"target": y, "a": X[:, 0], "b": X[:, 1],
+                      "c": X[:, 2]})
+        p = tmp_path / "d.parquet"
+        pq.write_table(t, str(p))
+        # the configured label_column index resolves against the schema
+        src = source_from_path(str(p), chunk_rows=32, label_col=0)
+        assert src.label_col == "target" and src.num_features == 3
+        xs, ys = zip(*src.chunks())
+        assert np.allclose(np.concatenate(xs), X)
+        assert np.allclose(np.concatenate(ys), y)
+        with pytest.raises(ValueError, match="not found"):
+            source_from_path(str(p), label_col="name:label")
+
+    def test_parquet_label_resolution(self):
+        # pure schema logic — runs without pyarrow
+        from lightgbm_tpu.streaming.sources import ParquetSource
+        names = ["f0", "target", "f1"]
+        r = ParquetSource._resolve_label
+        assert r(None, names) is None
+        assert r(1, names) == "target"
+        assert r("1", names) == "target"
+        assert r("name:target", names) == "target"
+        assert r("target", names) == "target"
+        with pytest.raises(ValueError, match="not found"):
+            r("name:label", names)   # the old hardcoded default
+        with pytest.raises(ValueError, match="out of range"):
+            r(7, names)
+
+    def test_csv_name_label_column_rejected(self, tmp_path, rng):
+        p = tmp_path / "d.csv"
+        write_csv(p, rng.randn(10, 3), np.zeros(10))
+        with pytest.raises(ValueError, match="header parsing"):
+            source_from_path(str(p), label_col="name:target")
 
     def test_synth_chunk_layout_invariance(self):
         from helpers.synth import SynthSource, synth_chunk
@@ -248,6 +285,88 @@ class PureStream(ChunkSource):
         step = self.chunk_rows
         for lo in range(start_chunk * step, len(self._X), step):
             yield self._X[lo:lo + step], self._y[lo:lo + step]
+
+
+# ------------------------------------------- multihost mapper sync
+
+class TestMapperSync:
+    """Pure streams under num_machines>1 must derive bin boundaries
+    collectively: per-rank local boundaries + a histogram psum silently
+    trains a wrong model (REVIEW: basic.py only synced array-backed
+    sources)."""
+
+    def test_mapper_sync_replaces_local_find(self):
+        from lightgbm_tpu.binning import find_bin_mappers
+        X, y = make_binary(n=1200, f=5, seed=9)
+        calls = []
+
+        def sync(sample):
+            calls.append(sample.shape)
+            return find_bin_mappers(np.asarray(sample))
+
+        got = build_streamed_dataset(PureStream(X, y, chunk_rows=300),
+                                     sample_rows=1200, mapper_sync=sync)
+        # the hook received the full covering sketch sample and its
+        # mappers are the ones the dataset was binned with
+        assert calls == [(1200, 5)]
+        assert_binned_equal(from_raw_ref(X, y), got)
+
+    def test_pure_stream_dataset_requests_sync_hook(self, tmp_path,
+                                                    monkeypatch):
+        # _construct_streamed must ask for the collective on every
+        # pure-stream construct (it returns None single-process); the
+        # array-backed path keeps using _distributed_bin_mappers
+        import lightgbm_tpu.basic as basic
+        X, y = make_binary(n=900, f=4, seed=5)
+        p = tmp_path / "d.csv"
+        write_csv(p, X, y)
+        requested = []
+        real = basic._streaming_mapper_sync
+
+        def spy(cfg, cat):
+            requested.append(True)
+            return real(cfg, cat)
+
+        monkeypatch.setattr(basic, "_streaming_mapper_sync", spy)
+        params = {"stream_input": True, "stream_chunk_rows": 200,
+                  "stream_sample_rows": 900, "verbosity": -1}
+        ds = lgb.Dataset(str(p), params=params).construct()
+        assert requested
+        assert_binned_equal(from_raw_ref(X, y), ds._binned)
+
+    def test_bin_parity_rejected_under_multihost(self):
+        # per-rank coverage failures would strand peers inside the
+        # mapper collective, so the combination fails fast on all ranks
+        X, y = make_binary(n=500, f=3, seed=2)
+        with pytest.raises(LightGBMError, match="num_machines=1"):
+            build_streamed_dataset(PureStream(X, y, chunk_rows=100),
+                                   sample_rows=500, bin_parity=True,
+                                   mapper_sync=lambda s: [])
+
+    def test_post_sketch_state_discarded_under_sync(self, tmp_path):
+        # resuming past the collective on one rank while peers enter it
+        # would deadlock the allgather: "bin"-phase state is only
+        # trusted single-process
+        from lightgbm_tpu.binning import find_bin_mappers
+        from lightgbm_tpu.streaming.loader import _save_stream_state
+        X, y = make_binary(n=800, f=4, seed=8)
+        ck = tmp_path / "ck"
+        _save_stream_state(str(ck), {
+            "phase": "bin", "next_chunk": 0, "num_features": 4,
+            "rows": 800, "sample_rows": 800, "exact": True,
+            "mappers": []},
+            {"labels": np.zeros(800, np.float32)})
+        calls = []
+
+        def sync(sample):
+            calls.append(sample.shape)
+            return find_bin_mappers(np.asarray(sample))
+
+        got = build_streamed_dataset(PureStream(X, y, chunk_rows=200),
+                                     sample_rows=800, mapper_sync=sync,
+                                     checkpoint_dir=str(ck))
+        assert calls == [(800, 4)]   # pass 1 re-ran through the hook
+        assert_binned_equal(from_raw_ref(X, y), got)
 
 
 # ------------------------------------------------ model.txt byte parity
@@ -378,6 +497,68 @@ class TestCheckpointResume:
         assert no_ckpt_line(got.model_to_string()) == \
             no_ckpt_line(ref.model_to_string())
         assert not state.exists()  # cleared after a successful pass
+
+    def test_torn_state_pair_discarded(self, tmp_path):
+        # json and npz are renamed in two os.replace calls; a kill
+        # between them must not resume with a cursor from chunk k over
+        # a sketch from chunk k+1 — the npz's _seq copy of the cursor
+        # detects the tear and load discards the pair
+        from lightgbm_tpu.streaming.loader import (_load_stream_state,
+                                                   _save_stream_state)
+        d = str(tmp_path / "ckpt")
+        _save_stream_state(d, {"phase": "sketch", "next_chunk": 3,
+                               "num_features": 2, "rows": 600},
+                           {"labels": np.zeros(600, np.float32)})
+        state, arrays = _load_stream_state(d)
+        assert state is not None and "_seq" not in arrays
+        j = json.loads((tmp_path / "ckpt" / "stream_state.json").read_text())
+        j["next_chunk"], j["rows"] = 2, 400
+        (tmp_path / "ckpt" / "stream_state.json").write_text(json.dumps(j))
+        assert _load_stream_state(d) == (None, None)
+
+    def test_torn_state_restart_end_to_end(self, tmp_path):
+        # a torn pair in the checkpoint dir restarts pass 1 from scratch
+        # (resumed_from_chunk 0) and still produces the in-memory bins
+        n = 1000
+        X, y = make_binary(n=n, f=5, seed=17)
+        p = tmp_path / "train.csv"
+        write_csv(p, X, y)
+        ck = tmp_path / "ckpt"
+        ck.mkdir()
+        from lightgbm_tpu.streaming.loader import _save_stream_state
+        _save_stream_state(str(ck), {"phase": "sketch", "next_chunk": 2,
+                                     "num_features": 5, "rows": 400},
+                           {"labels": np.zeros(400, np.float32)})
+        j = json.loads((ck / "stream_state.json").read_text())
+        j["next_chunk"], j["rows"] = 1, 200
+        (ck / "stream_state.json").write_text(json.dumps(j))
+        params = dict(self._params(tmp_path, n), stream_chunk_rows=200)
+        ds = lgb.Dataset(str(p), params=params).construct()
+        assert ds._binned.stream_stats.resumed_from_chunk == 0
+        assert_binned_equal(from_raw_ref(X, y), ds._binned)
+
+    def test_pass1_saves_throttled_subquadratic(self, tmp_path,
+                                                monkeypatch):
+        # each save rewrites the whole sketch + label buffer, so saving
+        # per chunk made checkpoint I/O O(rows^2/chunk) over the stream;
+        # the geometric growth rule keeps the save count logarithmic in
+        # chunks (total bytes O(N)) while the fault-window tests above
+        # still see a fresh-enough cursor
+        import lightgbm_tpu.streaming.loader as loader_mod
+        X, y = make_binary(n=2000, f=4, seed=3)
+        calls = []
+        real = loader_mod._save_stream_state
+
+        def counting(*a, **k):
+            calls.append(1)
+            return real(*a, **k)
+
+        monkeypatch.setattr(loader_mod, "_save_stream_state", counting)
+        build_streamed_dataset(PureStream(X, y, chunk_rows=50),
+                               sample_rows=2000,
+                               checkpoint_dir=str(tmp_path / "ck"))
+        n_chunks = 2000 // 50
+        assert 1 <= len(calls) < n_chunks // 2
 
     def test_state_ignored_by_checkpoint_latest(self, tmp_path):
         # stream_state.* must not be mistaken for a training checkpoint
