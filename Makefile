@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-all faults observe lint pipeline kernels stream bench install
+.PHONY: test test-slow test-all faults observe lint lint-sarif pipeline kernels stream bench install
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -18,6 +18,10 @@ test:
 lint:
 	$(PY) -m lightgbm_tpu.analysis lightgbm_tpu --format=json
 	$(PY) -m pytest tests/test_static_analysis.py -x -q -m lint
+
+# same run, SARIF 2.1.0 on stdout — for CI diff annotators
+lint-sarif:
+	$(PY) -m lightgbm_tpu.analysis lightgbm_tpu --format=sarif
 
 # the pipelined-executor tier: byte-parity vs the serial block loop,
 # device-eval fidelity, adaptive scheduler (tests/test_pipeline.py,

@@ -28,8 +28,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("paths", nargs="*",
                         help="files or directories to scan (default: the "
                              "installed lightgbm_tpu package)")
-    parser.add_argument("--format", choices=("text", "json"),
-                        default="text", help="output format")
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text", help="output format (sarif for "
+                        "CI diff annotation)")
     parser.add_argument("--show-suppressed", action="store_true",
                         help="also print suppressed findings (text mode)")
     parser.add_argument("--list-rules", action="store_true",
@@ -46,6 +47,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     findings = analyzer.run(paths)
     if args.format == "json":
         print(Analyzer.render_json(findings))
+    elif args.format == "sarif":
+        print(Analyzer.render_sarif(findings, analyzer.rules))
     else:
         print(Analyzer.render_text(findings,
                                    show_suppressed=args.show_suppressed))

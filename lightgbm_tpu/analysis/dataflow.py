@@ -1,0 +1,546 @@
+"""Intraprocedural CFG + rank-taint dataflow shared by the rule modules.
+
+The SPMD rules (rules_spmd.py) need more than per-node pattern checks:
+whether a `raise` strands peers in a collective is a *reachability*
+question, and whether a branch is rank-divergent is a *dataflow*
+question. This module provides both as small, dependency-free pieces:
+
+- `CFG`: statement-level control-flow graph over one function body
+  (if/for/while/try/with, raise/return/break/continue edges), with a
+  `reachable()` query used for "is a collective downstream of this
+  statement, avoiding that raise?".
+- `RankTaint`: flow-insensitive fixpoint taint over the function's
+  namespace. Two lattices:
+    * value taint — "this value can differ across ranks". Seeded by
+      rank-identity calls (`process_index`, `axis_index`, `host_id`)
+      everywhere, and by per-rank data extents (`len(...)`,
+      `.shape`/`.size` reads) in *host* code only: inside device
+      directories shapes are trace-static and shard-uniform, so a
+      `.shape` read there is not a divergence source.
+    * shape taint — "this array's shape can differ across ranks":
+      seeded by slices with rank-tainted bounds (`x[:n]`) and by
+      size-taking constructors (`rng.choice(n, size=k)`), cleared by
+      pad-to-static sanitizers (`np.pad`, `np.zeros`, ...). Shape
+      taint joins *clean-wins* across a name's assignments so the
+      standard conditional-pad idiom (`if n < per: x = np.pad(...)`)
+      reads as fixed-wire-shape.
+  Collective call results are rank-UNIFORM by construction (every rank
+  sees the same gathered value), so collectives *launder* taint: a
+  branch on an allgathered error flag is an agreement sync, not a
+  divergence — which is exactly the fix COLL002 asks for.
+
+Also hosts the structural helpers (`dotted_name`, `stmt_exprs`,
+`child_blocks`, `branch_tests`) the older rule modules grew private
+copies of; they now import from here.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "RANK_SOURCES", "COLLECTIVE_CALLABLES", "SHAPE_SANITIZERS",
+    "dotted_name", "call_name", "stmt_exprs", "child_blocks",
+    "branch_tests", "iter_top_functions", "collective_calls",
+    "CFGNode", "CFG", "RankTaint",
+]
+
+#: calls whose result is this rank's identity — the root divergence seed
+RANK_SOURCES = frozenset({"process_index", "axis_index", "host_id"})
+
+#: collective entry points: every rank must reach these together, and
+#: their results are rank-uniform (taint-laundering). Includes the
+#: package's own named collective wrappers (basic._allgather_find_mappers
+#: and the loader's mapper_sync hook) so rules see them as collectives.
+COLLECTIVE_CALLABLES = frozenset({
+    "psum", "psum_scatter", "pmean", "pmax", "pmin", "all_gather",
+    "all_to_all", "ppermute", "process_allgather",
+    "broadcast_one_to_all", "sync_global_devices",
+    "_allgather_find_mappers", "mapper_sync",
+})
+
+#: constructors that produce a statically-shaped array regardless of
+#: input shape — padding to the fixed wire shape clears shape taint
+SHAPE_SANITIZERS = frozenset({
+    "pad", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "broadcast_to",
+})
+
+#: calls whose *result shape* follows a value argument (rng.choice(n),
+#: np.arange(n), ...): value-tainted size -> shape-tainted result
+_SIZE_CALLS = frozenset({
+    "choice", "permutation", "randint", "arange", "repeat", "tile",
+    "linspace",
+})
+
+#: calls that always return a scalar — never shape-tainted
+_SCALAR_CALLS = frozenset({
+    "int", "float", "bool", "len", "min", "max", "sum", "round", "abs",
+})
+
+_SHAPE_ATTRS = ("shape", "size", "nbytes")
+
+
+# ---------------------------------------------------------------------------
+# structural helpers (shared with rules_jit / rules_lock)
+
+def dotted_name(node: ast.expr) -> str:
+    """``a.b.c`` for Name/Attribute chains, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_name(call: ast.Call) -> str:
+    """Last dotted segment of a call's callee ('' if not a name chain)."""
+    name = dotted_name(call.func)
+    if name:
+        return name.split(".")[-1]
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return ""
+
+
+#: expression-valued statement fields (a statement's OWN expressions,
+#: excluding its nested blocks)
+_STMT_EXPR_FIELDS = ("test", "iter", "value", "exc", "cause", "msg",
+                     "target", "targets", "annotation")
+
+
+def stmt_exprs(stmt: ast.stmt) -> List[ast.expr]:
+    """The expressions belonging to `stmt` itself — not to statements
+    nested inside its blocks. (`with` items and `return x` values are
+    included; an `if` contributes only its test.)"""
+    out: List[ast.expr] = []
+    for field in _STMT_EXPR_FIELDS:
+        val = getattr(stmt, field, None)
+        if val is None:
+            continue
+        if isinstance(val, ast.expr):
+            out.append(val)
+        elif isinstance(val, list):
+            out.extend(v for v in val if isinstance(v, ast.expr))
+    for item in getattr(stmt, "items", ()) or ():    # with-statements
+        out.append(item.context_expr)
+        if item.optional_vars is not None:
+            out.append(item.optional_vars)
+    return out
+
+
+def child_blocks(stmt: ast.stmt) -> List[List[ast.stmt]]:
+    """Every statement block nested directly under `stmt`."""
+    blocks: List[List[ast.stmt]] = []
+    for field in ("body", "orelse", "finalbody"):
+        val = getattr(stmt, field, None)
+        if isinstance(val, list) and val and isinstance(val[0], ast.stmt):
+            blocks.append(val)
+    for handler in getattr(stmt, "handlers", ()) or ():
+        blocks.append(handler.body)
+    for case in getattr(stmt, "cases", ()) or ():    # match-statements
+        blocks.append(case.body)
+    return blocks
+
+
+def branch_tests(root: ast.AST, include_range_for: bool = True
+                 ) -> Iterator[Tuple[ast.AST, List[ast.expr]]]:
+    """Yield (node, [condition exprs]) for every Python control-flow
+    construct under `root`: if/while/ifexp/assert tests, and the args
+    of `for _ in range(...)` loops."""
+    for node in ast.walk(root):
+        if isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+            yield node, [node.test]
+        elif include_range_for and isinstance(node, ast.For) and \
+                isinstance(node.iter, ast.Call) and \
+                isinstance(node.iter.func, ast.Name) and \
+                node.iter.func.id == "range":
+            yield node, list(node.iter.args)
+
+
+def iter_top_functions(tree: ast.AST) -> Iterator[ast.FunctionDef]:
+    """Module-level functions and first-level methods — the analysis
+    units for the SPMD rules (nested defs/lambdas are analyzed as part
+    of their enclosing top function: closures share the namespace)."""
+    for node in getattr(tree, "body", ()):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield sub
+
+
+def collective_calls(root: ast.AST) -> List[ast.Call]:
+    """Every call under `root` whose callee name is a collective."""
+    return [node for node in ast.walk(root)
+            if isinstance(node, ast.Call)
+            and call_name(node) in COLLECTIVE_CALLABLES]
+
+
+# ---------------------------------------------------------------------------
+# CFG
+
+class CFGNode:
+    """One statement in the graph. `kind` tags exits: raise/return."""
+    __slots__ = ("stmt", "succs", "kind")
+
+    def __init__(self, stmt: Optional[ast.stmt], kind: str = "stmt"):
+        self.stmt = stmt
+        self.succs: List["CFGNode"] = []
+        self.kind = kind
+
+    def __repr__(self) -> str:        # pragma: no cover - debugging aid
+        line = getattr(self.stmt, "lineno", "?")
+        return f"<CFGNode {self.kind} line {line}>"
+
+
+class CFG:
+    """Statement-level control-flow graph of one function body.
+
+    Approximations (documented so rule behavior is predictable):
+    exceptions raised by any top-level statement of a `try` body may
+    reach every handler; loops may execute zero times; `match` takes
+    any case or falls through. Nested function/class definitions are
+    single opaque nodes (their bodies do not execute here)."""
+
+    def __init__(self, fn: ast.FunctionDef):
+        self.exit = CFGNode(None, kind="exit")
+        self.nodes: List[CFGNode] = []
+        self._of: Dict[int, CFGNode] = {}
+        self.entry = self._seq(fn.body, self.exit, None)
+
+    def node(self, stmt: ast.stmt) -> Optional[CFGNode]:
+        return self._of.get(id(stmt))
+
+    def reachable(self, start: CFGNode,
+                  avoid: Optional[CFGNode] = None) -> Set[CFGNode]:
+        """Nodes reachable from `start` (inclusive) without passing
+        through `avoid`."""
+        seen: Set[CFGNode] = set()
+        work = [start]
+        while work:
+            nd = work.pop()
+            if nd in seen or nd is avoid:
+                continue
+            seen.add(nd)
+            work.extend(nd.succs)
+        return seen
+
+    # ------------------------------------------------------------------
+    def _make(self, stmt: ast.stmt) -> CFGNode:
+        n = CFGNode(stmt)
+        self.nodes.append(n)
+        self._of[id(stmt)] = n
+        return n
+
+    def _seq(self, stmts: Sequence[ast.stmt], follow: CFGNode,
+             loop: Optional[Tuple[CFGNode, CFGNode]]) -> CFGNode:
+        entry = follow
+        for stmt in reversed(stmts):
+            entry = self._stmt(stmt, entry, loop)
+        return entry
+
+    def _stmt(self, stmt: ast.stmt, follow: CFGNode,
+              loop: Optional[Tuple[CFGNode, CFGNode]]) -> CFGNode:
+        n = self._make(stmt)
+        if isinstance(stmt, ast.Return):
+            n.kind = "return"
+            n.succs = [self.exit]
+        elif isinstance(stmt, ast.Raise):
+            n.kind = "raise"
+            n.succs = [self.exit]
+        elif isinstance(stmt, ast.Assert):
+            n.kind = "assert"
+            n.succs = [follow, self.exit]
+        elif isinstance(stmt, ast.If):
+            n.succs = [self._seq(stmt.body, follow, loop),
+                       self._seq(stmt.orelse, follow, loop)]
+        elif isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            body = self._seq(stmt.body, n, (n, follow))
+            after = self._seq(stmt.orelse, follow, loop)
+            n.succs = [body, after]
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            n.succs = [self._seq(stmt.body, follow, loop)]
+        elif isinstance(stmt, ast.Try) or \
+                isinstance(stmt, getattr(ast, "TryStar", ())):
+            final_entry = (self._seq(stmt.finalbody, follow, loop)
+                           if stmt.finalbody else follow)
+            handlers = [self._seq(h.body, final_entry, loop)
+                        for h in stmt.handlers]
+            after_body = (self._seq(stmt.orelse, final_entry, loop)
+                          if stmt.orelse else final_entry)
+            body = self._seq(stmt.body, after_body, loop)
+            n.succs = [body]
+            # any top-level body statement may raise into any handler
+            for s in stmt.body:
+                bn = self._of.get(id(s))
+                if bn is not None:
+                    bn.succs = list(bn.succs) + handlers
+        elif isinstance(stmt, ast.Break):
+            n.succs = [loop[1] if loop else self.exit]
+        elif isinstance(stmt, ast.Continue):
+            n.succs = [loop[0] if loop else self.exit]
+        elif isinstance(stmt, getattr(ast, "Match", ())):
+            cases = [self._seq(c.body, follow, loop)
+                     for c in stmt.cases]
+            n.succs = cases + [follow]
+        else:
+            # simple statements, plus opaque nested defs/classes
+            n.succs = [follow]
+        return n
+
+
+# ---------------------------------------------------------------------------
+# taint
+
+class RankTaint:
+    """Flow-insensitive rank-divergence taint over one top function.
+
+    `shape_seeds=False` (device code) disables the `.shape`/`len()`
+    value seeds; rank-identity calls still seed everywhere."""
+
+    def __init__(self, fn: ast.FunctionDef, shape_seeds: bool = True):
+        self.fn = fn
+        self.shape_seeds = shape_seeds
+        self.value: Set[str] = set()
+        self.shape: Set[str] = set()
+        # name -> list of ("expr"|"iter", rhs expression) descriptors
+        self._assigns: Dict[str, List[Tuple[str, ast.expr]]] = {}
+        # (base name, rhs) for container stores x[i] = rhs / x.a = rhs
+        self._stores: List[Tuple[str, ast.expr]] = []
+        # names bound inside a for/while body: whether such a name was
+        # bound at all can depend on rank-local iteration counts, so
+        # `x is None` on them IS divergent (see _taints on Compare)
+        self.loop_bound: Set[str] = set()
+        self._collect()
+        self._fix_value()
+        self._fix_shape()
+
+    # -- public queries -------------------------------------------------
+    def expr_tainted(self, expr: ast.expr) -> bool:
+        return self._taints(expr)[0]
+
+    def expr_shape_tainted(self, expr: ast.expr) -> bool:
+        return self._taints(expr)[1]
+
+    def stmt_test_tainted(self, stmt: ast.stmt) -> bool:
+        """Is the statement's controlling expression rank-divergent?
+        (if/while test, for iterable.)"""
+        test = getattr(stmt, "test", None)
+        if test is not None:
+            return self.expr_tainted(test)
+        it = getattr(stmt, "iter", None)
+        if it is not None:
+            return self.expr_tainted(it)
+        return False
+
+    # -- assignment collection ------------------------------------------
+    def _collect(self) -> None:
+        for node in ast.walk(self.fn):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    self._bind(tgt, node.value)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                self._bind(node.target, node.value)
+            elif isinstance(node, ast.AugAssign):
+                self._bind(node.target, node.value)
+            elif isinstance(node, ast.NamedExpr):
+                self._bind(node.target, node.value)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                self._bind(node.target, node.iter, kind="iter")
+            elif isinstance(node, ast.comprehension):
+                self._bind(node.target, node.iter, kind="iter")
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        self._bind(item.optional_vars, item.context_expr)
+        for node in ast.walk(self.fn):
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                for sub in ast.walk(node):
+                    if sub is node:
+                        continue
+                    if isinstance(sub, ast.Assign):
+                        for tgt in sub.targets:
+                            self._bound_names(tgt)
+                    elif isinstance(sub, (ast.AugAssign, ast.AnnAssign,
+                                          ast.NamedExpr)):
+                        self._bound_names(sub.target)
+
+    def _bound_names(self, target: ast.expr) -> None:
+        if isinstance(target, ast.Name):
+            self.loop_bound.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bound_names(elt)
+        elif isinstance(target, ast.Starred):
+            self._bound_names(target.value)
+
+    def _bind(self, target: ast.expr, rhs: ast.expr,
+              kind: str = "expr") -> None:
+        if isinstance(target, ast.Name):
+            self._assigns.setdefault(target.id, []).append((kind, rhs))
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, rhs, kind="iter" if kind == "iter"
+                           else "unpack")
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, rhs, kind)
+        elif isinstance(target, (ast.Subscript, ast.Attribute)):
+            base = target
+            while isinstance(base, (ast.Subscript, ast.Attribute)):
+                base = base.value
+            if isinstance(base, ast.Name):
+                self._stores.append((base.id, rhs))
+
+    # -- value fixpoint (monotone) --------------------------------------
+    def _fix_value(self) -> None:
+        for _ in range(24):
+            # shape can feed value (len(x) of shape-tainted x), so the
+            # two lattices converge together
+            shape_before = set(self.shape)
+            self._fix_shape_once()
+            changed = self.shape != shape_before
+            for name, rhss in self._assigns.items():
+                if name in self.value:
+                    continue
+                for _kind, rhs in rhss:
+                    v, s = self._taints(rhs)
+                    if v or s:
+                        # iterating / unpacking a shape-tainted container
+                        # yields rank-divergent element counts too
+                        self.value.add(name)
+                        changed = True
+                        break
+            for name, rhs in self._stores:
+                if name not in self.value and self._taints(rhs)[0]:
+                    self.value.add(name)
+                    changed = True
+            if not changed:
+                break
+
+    # -- shape fixpoint (clean-wins join) -------------------------------
+    def _fix_shape_once(self) -> None:
+        new: Set[str] = set()
+        for name, rhss in self._assigns.items():
+            flags = []
+            for kind, rhs in rhss:
+                if kind in ("iter", "unpack"):
+                    # loop elements / unpacked items: scalar-ish
+                    flags.append(False)
+                else:
+                    flags.append(self._taints(rhs)[1])
+            if flags and all(flags):
+                new.add(name)
+        self.shape = new
+
+    def _fix_shape(self) -> None:
+        for _ in range(12):
+            before = set(self.shape)
+            self._fix_shape_once()
+            if self.shape == before:
+                break
+
+    # -- expression transfer --------------------------------------------
+    def _taints(self, e: Optional[ast.expr]) -> Tuple[bool, bool]:
+        if e is None:
+            return (False, False)
+        if isinstance(e, ast.Name):
+            return (e.id in self.value, e.id in self.shape)
+        if isinstance(e, ast.Constant):
+            return (False, False)
+        if isinstance(e, ast.Call):
+            return self._call_taints(e)
+        if isinstance(e, ast.Compare) and len(e.ops) == 1 and \
+                isinstance(e.ops[0], (ast.Is, ast.IsNot)):
+            # `x is None` is a *structural* test: noneness is
+            # rank-uniform (same code path constructed x everywhere) —
+            # UNLESS x is bound inside a loop, where a rank-local
+            # iteration count decides whether the binding happened at
+            # all (the empty-stream `sk is None` shape)
+            sides = [e.left, e.comparators[0]]
+            if any(isinstance(s, ast.Constant) and s.value is None
+                   for s in sides):
+                other = next(s for s in sides
+                             if not (isinstance(s, ast.Constant)
+                                     and s.value is None))
+                if isinstance(other, ast.Name):
+                    return (other.id in self.loop_bound, False)
+                return (False, False)
+        if isinstance(e, ast.Attribute):
+            bv, bs = self._taints(e.value)
+            if e.attr in _SHAPE_ATTRS:
+                return (self.shape_seeds or bs, False)
+            return (bv, bs)
+        if isinstance(e, ast.Subscript):
+            bv, bs = self._taints(e.value)
+            sv, sliced = self._slice_taints(e.slice)
+            return (bv or sv, bs or sliced)
+        if isinstance(e, ast.IfExp):
+            tv, _ = self._taints(e.test)
+            bv, bs = self._taints(e.body)
+            ov, os_ = self._taints(e.orelse)
+            return (tv or bv or ov, bs or os_)
+        if isinstance(e, ast.Lambda):
+            return (False, False)
+        # generic: OR over child expressions (BinOp, BoolOp, Compare,
+        # Tuple, comprehensions, f-strings, ...)
+        v = s = False
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, ast.expr):
+                cv, cs = self._taints(child)
+                v, s = v or cv, s or cs
+            elif isinstance(child, ast.comprehension):
+                cv, cs = self._taints(child.iter)
+                v, s = v or cv or cs, s
+        return (v, s)
+
+    def _slice_taints(self, sl: ast.expr) -> Tuple[bool, bool]:
+        """(index value taint, result-shape taint) of a subscript slice."""
+        if isinstance(sl, ast.Slice):
+            bounds = [sl.lower, sl.upper, sl.step]
+            tainted = any(self._taints(b)[0] for b in bounds if b)
+            return (tainted, tainted)
+        if isinstance(sl, ast.Tuple):
+            v = s = False
+            for elt in sl.elts:
+                ev, es = self._slice_taints(elt)
+                v, s = v or ev, s or es
+            return (v, s)
+        v, s = self._taints(sl)
+        # a tainted-shape index array selects a divergent row count
+        return (v, s)
+
+    def _call_taints(self, call: ast.Call) -> Tuple[bool, bool]:
+        fname = call_name(call)
+        args: List[ast.expr] = list(call.args)
+        args += [kw.value for kw in call.keywords if kw.value is not None]
+        if isinstance(call.func, ast.Attribute):
+            args.append(call.func.value)   # method receiver
+        av = ash = False
+        for a in args:
+            if isinstance(a, ast.Starred):
+                a = a.value
+            v, s = self._taints(a)
+            av, ash = av or v, ash or s
+        if fname in RANK_SOURCES:
+            return (True, False)
+        if fname in COLLECTIVE_CALLABLES:
+            return (False, False)          # rank-uniform result
+        if fname in SHAPE_SANITIZERS:
+            return (av, False)             # static shape by construction
+        if fname == "len":
+            return (self.shape_seeds or av or ash, False)
+        if fname in _SCALAR_CALLS:
+            return (av or ash, False)
+        size_kw = any(
+            kw.arg in ("size", "shape", "num", "n")
+            and kw.value is not None and self._taints(kw.value)[0]
+            for kw in call.keywords)
+        if fname in _SIZE_CALLS and (av or size_kw):
+            return (av, True)
+        return (av, ash or size_kw)

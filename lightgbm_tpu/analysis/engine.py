@@ -14,9 +14,10 @@ the per-tree inner loop device-resident).
 Architecture:
 
 - `ParsedFile`: one source file — path, source, `ast` tree, per-line
-  suppression sets parsed from ``# tpulint: disable=RULE[,RULE...]``
+  suppression sets parsed from ``tpulint: disable=<RULE>[,<RULE>...]``
   comments (``disable=all`` silences every rule on that line;
-  ``disable-file=`` applies to the whole file).
+  ``disable-file=`` applies to the whole file). SUP001 flags
+  suppressions that name unknown rules or suppress nothing.
 - `Rule`: per-file analysis (`check(parsed) -> findings`).
 - `ProjectRule`: whole-project analysis (`check_project(files, ctx)`)
   for cross-file invariants — registry consistency, lock-order graphs.
@@ -38,8 +39,8 @@ import re
 from typing import Dict, Iterable, List, Optional, Sequence
 
 __all__ = [
-    "Finding", "ParsedFile", "Rule", "ProjectRule", "Analyzer",
-    "all_rules", "DEVICE_DIRS",
+    "Finding", "ParsedFile", "Rule", "ProjectRule",
+    "StaleSuppressionRule", "Analyzer", "all_rules", "DEVICE_DIRS",
 ]
 
 #: package subdirectories whose code runs (or stages) device compute;
@@ -86,6 +87,10 @@ class ParsedFile:
         # line number -> set of rule ids disabled on that line
         self.line_suppressions: Dict[int, set] = {}
         self.file_suppressions: set = set()
+        # (comment line, "line"|"file", rule id) — kept per-comment so
+        # the stale-suppression self-check (SUP001) can point at the
+        # exact comment that suppresses nothing
+        self.suppression_comments: List[tuple] = []
         for lineno, text in enumerate(self.lines, start=1):
             m = _SUPPRESS_RE.search(text)
             if not m:
@@ -93,9 +98,13 @@ class ParsedFile:
             rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
             if m.group(1) == "disable-file":
                 self.file_suppressions |= rules
+                self.suppression_comments += [
+                    (lineno, "file", r) for r in sorted(rules)]
             else:
                 self.line_suppressions.setdefault(lineno, set()).update(
                     rules)
+                self.suppression_comments += [
+                    (lineno, "line", r) for r in sorted(rules)]
 
     # ------------------------------------------------------------------
     def is_suppressed(self, rule: str, line: int) -> bool:
@@ -143,6 +152,48 @@ class ProjectRule(Rule):
     def check_project(self, files: Sequence[ParsedFile],
                       ctx: "ProjectContext") -> List[Finding]:
         raise NotImplementedError
+
+
+class StaleSuppressionRule(Rule):
+    """SUP001 is driven by the Analyzer itself (it needs the final
+    finding set to know whether a suppression still suppresses
+    anything); the class exists so the rule appears in the catalogue
+    and can itself be suppressed/filtered like any other."""
+
+    id = "SUP001"
+    doc = ("`# tpulint: disable` comment that names an unknown rule id "
+           "or no longer suppresses any finding — dead suppressions "
+           "rot silently; delete the comment or fix the rule id")
+
+    def check(self, parsed: ParsedFile) -> List[Finding]:
+        return []
+
+    def check_run(self, files: Sequence[ParsedFile],
+                  findings: Sequence[Finding],
+                  known_ids: Iterable[str]) -> List[Finding]:
+        known = set(known_ids) | {"all", "PARSE001"}
+        out: List[Finding] = []
+        for parsed in files:
+            for lineno, kind, rule_id in parsed.suppression_comments:
+                if rule_id not in known:
+                    out.append(self.finding(
+                        parsed, lineno,
+                        f"suppression names unknown rule '{rule_id}'"))
+                    continue
+                if kind == "file":
+                    live = any(f.path == parsed.path
+                               and (rule_id == "all" or f.rule == rule_id)
+                               for f in findings)
+                else:
+                    live = any(f.path == parsed.path and f.line == lineno
+                               and (rule_id == "all" or f.rule == rule_id)
+                               for f in findings)
+                if not live:
+                    out.append(self.finding(
+                        parsed, lineno,
+                        f"stale suppression: 'disable{'-file' if kind == 'file' else ''}"
+                        f"={rule_id}' no longer suppresses any finding"))
+        return out
 
 
 class ProjectContext:
@@ -220,6 +271,8 @@ def all_rules() -> List[Rule]:
     from .rules_registry import (CliTaskRoutingRule, ConfigAttrRule,
                                  FaultSiteRegistryRule, ParamDocsRule,
                                  PrometheusDocsRule)
+    from .rules_spmd import (CollectiveBranchRule, CollectiveRaiseRule,
+                             CollectiveRegistryRule, CollectiveShapeRule)
     rules: List[Rule] = [
         JitStaticScalarRule(), JitPythonControlFlowRule(),
         JitHostSyncRule(), JitDonationReuseRule(),
@@ -229,6 +282,9 @@ def all_rules() -> List[Rule]:
         ParamDocsRule(), CliTaskRoutingRule(), ConfigAttrRule(),
         FaultSiteRegistryRule(), PrometheusDocsRule(),
         FaultCoverageRule(),
+        CollectiveBranchRule(), CollectiveRaiseRule(),
+        CollectiveShapeRule(), CollectiveRegistryRule(),
+        StaleSuppressionRule(),
     ]
     return sorted(rules, key=lambda r: r.id)
 
@@ -270,6 +326,13 @@ class Analyzer:
         for rule in self.rules:
             if isinstance(rule, ProjectRule):
                 findings.extend(rule.check_project(files, ctx))
+        # stale-suppression self-check: runs over the FINAL finding set
+        # (a suppression is live iff it suppresses one of these)
+        sup = next((r for r in self.rules
+                    if isinstance(r, StaleSuppressionRule)), None)
+        if sup is not None:
+            findings.extend(sup.check_run(
+                files, findings, (r.id for r in self.rules)))
         for f in findings:
             parsed = by_path.get(f.path)
             if parsed is not None and parsed.is_suppressed(f.rule, f.line):
@@ -296,4 +359,50 @@ class Analyzer:
             "findings": [f.to_dict() for f in findings],
             "unsuppressed": len(active),
             "suppressed": len(findings) - len(active),
+        }, indent=2)
+
+    @staticmethod
+    def render_sarif(findings: Sequence[Finding],
+                     rules: Optional[Sequence[Rule]] = None) -> str:
+        """SARIF 2.1.0 — the CI-annotation interchange format.
+
+        Suppressed findings are emitted with an ``inSource``
+        suppression record rather than dropped, so diff annotators can
+        distinguish "fixed" from "silenced"."""
+        if rules is None:
+            rules = all_rules()
+        results = []
+        for f in findings:
+            result = {
+                "ruleId": f.rule,
+                "level": "error" if f.severity == "error" else "warning",
+                "message": {"text": f.message},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path.replace(os.sep, "/")},
+                        "region": {"startLine": max(1, f.line)},
+                    },
+                }],
+            }
+            if f.suppressed:
+                result["suppressions"] = [{"kind": "inSource"}]
+            results.append(result)
+        driver = {
+            "name": "tpulint",
+            "informationUri":
+                "https://example.invalid/docs/StaticAnalysis.md",
+            "rules": [{
+                "id": r.id,
+                "defaultConfiguration": {
+                    "level": "error" if r.severity == "error"
+                    else "warning"},
+                "shortDescription": {"text": r.doc or r.id},
+            } for r in rules],
+        }
+        return json.dumps({
+            "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                       "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+            "version": "2.1.0",
+            "runs": [{"tool": {"driver": driver}, "results": results}],
         }, indent=2)

@@ -20,6 +20,7 @@ import ast
 import os
 from typing import List, Sequence
 
+from .dataflow import call_name
 from .engine import Finding, ParsedFile, ProjectContext, ProjectRule
 
 __all__ = ["FaultCoverageRule", "DISPATCH_MANIFEST", "SITE_WRAPPERS"]
@@ -38,6 +39,7 @@ DISPATCH_MANIFEST = (
 SITE_WRAPPERS = {
     "_maybe_inject_fused_fault": "fused_dispatch",
     "check_collective_fault": "collective_psum",
+    "_ingest_chunk_step": "streaming_ingest",
 }
 
 #: manifest basenames that are ambiguous in the package (engine.py
@@ -56,10 +58,8 @@ def _function_covers_site(fn: ast.AST, site: str) -> bool:
         if isinstance(node, ast.Constant) and node.value == site:
             return True
         if isinstance(node, ast.Call):
-            name = node.func.attr if isinstance(node.func, ast.Attribute) \
-                else node.func.id if isinstance(node.func, ast.Name) \
-                else None
-            if name is not None and SITE_WRAPPERS.get(name) == site:
+            name = call_name(node)
+            if name and SITE_WRAPPERS.get(name) == site:
                 return True
     return False
 

@@ -26,6 +26,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from .dataflow import branch_tests, dotted_name
 from .engine import Finding, ParsedFile, Rule
 
 __all__ = ["JitStaticScalarRule", "JitPythonControlFlowRule",
@@ -63,13 +64,9 @@ def _dec_is_jit(expr: ast.expr) -> Tuple[bool, Set[str]]:
     return False, set()
 
 
-def _dotted_name(expr: ast.expr) -> Optional[str]:
-    if isinstance(expr, ast.Name):
-        return expr.id
-    if isinstance(expr, ast.Attribute):
-        base = _dotted_name(expr.value)
-        return f"{base}.{expr.attr}" if base else None
-    return None
+#: shared with rules_pallas; the canonical implementation lives in
+#: dataflow (returns '' — falsy, like the old None — for non-chains)
+_dotted_name = dotted_name
 
 
 def _static_names_from_call(call: ast.Call) -> Set[str]:
@@ -239,19 +236,7 @@ class JitPythonControlFlowRule(Rule):
     def check(self, parsed: ParsedFile) -> List[Finding]:
         findings: List[Finding] = []
         for func, _static, traced in _jit_bodies(parsed):
-            for node in ast.walk(func):
-                tests: List[ast.expr] = []
-                if isinstance(node, (ast.If, ast.While)):
-                    tests.append(node.test)
-                elif isinstance(node, ast.IfExp):
-                    tests.append(node.test)
-                elif isinstance(node, ast.Assert):
-                    tests.append(node.test)
-                elif isinstance(node, ast.For) and \
-                        isinstance(node.iter, ast.Call) and \
-                        isinstance(node.iter.func, ast.Name) and \
-                        node.iter.func.id == "range":
-                    tests.extend(node.iter.args)
+            for node, tests in branch_tests(func):
                 for test in tests:
                     for name in _offending_names(test, traced):
                         findings.append(self.finding(

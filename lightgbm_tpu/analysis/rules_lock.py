@@ -30,6 +30,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from .dataflow import child_blocks, stmt_exprs
 from .engine import Finding, ParsedFile, ProjectContext, ProjectRule, Rule
 
 __all__ = ["LockDisciplineRule", "LockOrderRule", "collect_lock_classes"]
@@ -192,25 +193,12 @@ class _LockWalker:
                     self._walk_body(sub.body, locked=False, method=method,
                                     exempt=exempt)
             return
-        # generic statement: scan expressions, recurse into blocks
-        for field in ("test", "iter", "value", "exc", "msg", "target",
-                      "targets"):
-            val = getattr(stmt, field, None)
-            if isinstance(val, ast.expr):
-                self._scan_expr(val, locked, method, exempt)
-            elif isinstance(val, list):
-                for v in val:
-                    if isinstance(v, ast.expr):
-                        self._scan_expr(v, locked, method, exempt)
-        if isinstance(stmt, ast.Expr):
-            self._scan_expr(stmt.value, locked, method, exempt)
-        for block in ("body", "orelse", "finalbody"):
-            sub = getattr(stmt, block, None)
-            if isinstance(sub, list) and sub and \
-                    isinstance(sub[0], ast.stmt):
-                self._walk_body(sub, locked, method, exempt)
-        for handler in getattr(stmt, "handlers", ()):
-            self._walk_body(handler.body, locked, method, exempt)
+        # generic statement: scan its own expressions (dataflow.
+        # stmt_exprs), recurse into its blocks (dataflow.child_blocks)
+        for expr in stmt_exprs(stmt):
+            self._scan_expr(expr, locked, method, exempt)
+        for block in child_blocks(stmt):
+            self._walk_body(block, locked, method, exempt)
 
     def _scan_expr(self, expr: ast.expr, locked: bool, method: str,
                    exempt: bool) -> None:

@@ -33,7 +33,8 @@ import ast
 from typing import List, Optional, Set
 
 from .engine import Finding, ParsedFile, Rule
-from .rules_jit import _dotted_name, iter_jitted_functions
+from .dataflow import dotted_name as _dotted_name
+from .rules_jit import iter_jitted_functions
 
 __all__ = ["PallasKernelRule"]
 

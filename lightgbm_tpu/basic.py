@@ -180,11 +180,31 @@ def _allgather_find_mappers(sample, cfg, cat, sparse_in=False):
     all ranks derive IDENTICAL BinMappers from the union — the TPU form
     of the reference's per-rank FindBin + Allgather of serialized
     mappers (dataset_loader.cpp:722-807). Must be called by every rank
-    at the same program point."""
+    at the same program point.
+
+    `sample=None` signals that this rank failed rank-local validation
+    (e.g. its stream partition was empty): the rank still joins the
+    agreement gather below, and then EVERY rank raises the same error.
+    That agreement-before-data protocol is what makes rank-local
+    failure safe here — a bare raise before the row allgather would
+    strand peers in the collective (tpulint COLL002, the PR-7
+    stream_bin_parity bug shape)."""
     import jax
     from jax.experimental import multihost_utils
     from .binning import find_bin_mappers
+    from .parallel.comm import check_collective_fault
+    check_collective_fault()
     nproc = jax.process_count()
+    # agreement sync: gather one ok-flag per rank before any rank ships
+    # rows, so validation failure is raised identically everywhere
+    ok = np.asarray(0 if sample is None else 1, np.int64)
+    oks = np.asarray(multihost_utils.process_allgather(ok)).reshape(-1)
+    if int(oks.min(initial=1)) == 0:
+        bad = [r for r in range(oks.shape[0]) if int(oks[r]) == 0]
+        raise LightGBMError(
+            f"distributed bin finding: rank(s) {bad} produced no "
+            f"sample rows (empty partition?) — all ranks abort "
+            f"together")
     per = max(1, cfg.bin_construct_sample_cnt // nproc)
     n_local = sample.shape[0]
     # variable-size sample gather with fixed wire shapes: every rank

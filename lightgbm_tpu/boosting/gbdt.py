@@ -125,6 +125,8 @@ class GBDT:
                 # deterministic fixed-size local row sample -> allgather
                 # -> every rank plans over the identical pooled sample.
                 from jax.experimental import multihost_utils
+                from ..parallel.comm import check_collective_fault
+                check_collective_fault()
                 k_samp = max(1, 20000 // nproc_now)
                 rs = np.random.RandomState(13)
                 n_loc = plan_bins.shape[0]
@@ -435,6 +437,8 @@ class GBDT:
                 # machines pad to the LARGEST partition (padded rows
                 # carry zero grad/hess/count — they contribute nothing)
                 from jax.experimental import multihost_utils
+                from ..parallel.comm import check_collective_fault
+                check_collective_fault()
                 sizes = np.asarray(multihost_utils.process_allgather(
                     np.asarray(self.num_data, np.int64)))
                 target = int(-(-int(sizes.max()) // ndev_local)
@@ -792,6 +796,8 @@ class GBDT:
         lv = np.asarray(tree.leaf_value, np.float64)
         has = (cnts > 0).astype(np.float64)
         contrib = np.stack([np.where(has > 0, lv, 0.0), has])
+        from ..parallel.comm import check_collective_fault
+        check_collective_fault()
         total = np.asarray(multihost_utils.process_allgather(
             np.ascontiguousarray(contrib))).sum(axis=0)
         nz = np.maximum(total[1], 1.0)
@@ -1499,6 +1505,8 @@ class GBDT:
             # machines (GlobalSyncUpByMean), each rank having computed
             # from its local partition
             from jax.experimental import multihost_utils
+            from ..parallel.comm import check_collective_fault
+            check_collective_fault()
             init = float(np.mean(multihost_utils.process_allgather(
                 np.float32(init))))
         if abs(init) > 1e-35:

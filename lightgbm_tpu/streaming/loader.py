@@ -210,8 +210,11 @@ def build_streamed_dataset(
     `mapper_sync`, when set (multihost pure streams), replaces the local
     `find_bin_mappers` call: it receives the pass-1 sketch sample and
     must return the mapper list every rank agrees on (a collective —
-    every rank reaches it exactly once per ingest). The returned
-    dataset carries `stream_stats`.
+    every rank reaches it exactly once per ingest). A `None` sample
+    means this rank's stream yielded no rows: the sync must still join
+    the collective and then raise identically on every rank, so a
+    lone empty partition fails the job loudly instead of hanging it.
+    The returned dataset carries `stream_stats`.
     """
     if mapper_sync is not None and bin_parity:
         # parity is a single-process guarantee; multihost boundaries
@@ -324,16 +327,16 @@ def build_streamed_dataset(
                 next_save_rows = int(rows_total * _SAVE_GROWTH) + 1
                 last_save_t = time.monotonic()
         if sk is None:
+            if mapper_sync is not None:
+                # an empty local stream is rank-local state: join the
+                # mapper collective with a None sample so every peer
+                # raises the same error instead of hanging in the
+                # allgather waiting for this rank (tpulint COLL002)
+                mapper_sync(None)
             raise LightGBMError("streaming: source yielded no chunks")
         num_rows = (rows_before or 0) + counted
         stats.sample_rows = sk.sample_rows
         stats.exact = sk.is_exact
-        if bin_parity and not sk.is_exact:
-            raise LightGBMError(
-                f"stream_bin_parity: sketch capacity {sk.capacity} < "
-                f"{sk.rows_seen} rows seen — boundaries would be "
-                "approximate; raise stream_sample_rows to cover the "
-                "stream or drop stream_bin_parity")
         if not sk.is_exact:
             Log.info(
                 f"streaming: sketch sampled {sk.sample_rows} of "
@@ -345,6 +348,17 @@ def build_streamed_dataset(
             # partitions still bin against identical boundaries
             all_mappers = mapper_sync(sk.sample())
         else:
+            # parity is checked on the local-binning arm only: the
+            # mapper_sync+bin_parity combination was rejected at entry,
+            # and a rank-local raise between sketching and the mapper
+            # collective strands peers in the allgather (tpulint
+            # COLL002 — the PR-7 multihost bug shape)
+            if bin_parity and not sk.is_exact:
+                raise LightGBMError(
+                    f"stream_bin_parity: sketch capacity {sk.capacity} "
+                    f"< {sk.rows_seen} rows seen — boundaries would be "
+                    "approximate; raise stream_sample_rows to cover "
+                    "the stream or drop stream_bin_parity")
             # identical call to the in-memory path: with a covering
             # sketch the sample IS the data in stream order, so
             # boundaries (and the model) are bit-identical;

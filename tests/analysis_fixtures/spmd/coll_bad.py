@@ -1,0 +1,50 @@
+"""Known-bad SPMD fixture: each COLL001/002/003 shape at a pinned line.
+
+Host-side module (not under a device dir), so per-rank data extents
+(len(...), .shape reads) seed the rank-taint — the conditions of the
+streaming ingest path these rules were built for. Every function here
+deadlocks or diverges a real multihost run.
+"""
+import jax
+import numpy as np
+from jax.experimental import multihost_utils
+
+
+def branch_deadlock(x):
+    r = jax.process_index()
+    if r == 0:
+        return jax.lax.psum(x, "data")
+    return x
+
+
+def loop_deadlock(chunks):
+    total = 0
+    for i in range(len(chunks)):
+        total = total + jax.lax.psum(chunks[i], "data")
+    return total
+
+
+def cond_expr_deadlock(x):
+    r = jax.process_index()
+    return jax.lax.psum(x, "data") if r > 0 else x
+
+
+def stranded_raise(rows):
+    if len(rows) == 0:
+        raise ValueError("empty shard on this rank")
+    return multihost_utils.process_allgather(rows)
+
+
+def pr7_bin_parity(sample, mapper_sync):
+    # the PR-7 stream_bin_parity bug shape: rank-local validation with
+    # a bare raise while peers proceed into the mapper collective
+    if len(sample) > 100:
+        return mapper_sync(sample)
+    else:
+        raise ValueError("bin parity check failed on this rank")
+
+
+def ragged_gather(rows):
+    n = len(rows)
+    head = rows[:n]
+    return multihost_utils.process_allgather(head)

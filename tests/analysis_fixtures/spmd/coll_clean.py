@@ -1,0 +1,53 @@
+"""Clean twins of coll_bad.py: the sanctioned multihost idioms.
+
+Every function here is the repaired form of a coll_bad shape and must
+stay silent — matching collectives on both arms, agreement-sync before
+raising, participate-then-raise, pad-to-static-wire-shape, and
+branches/loops on rank-uniform configuration.
+"""
+import jax
+import numpy as np
+from jax.experimental import multihost_utils
+
+
+def branch_both_arms(x):
+    r = jax.process_index()
+    if r == 0:
+        y = jax.lax.psum(x, "data")
+    else:
+        y = jax.lax.psum(x * 0, "data")
+    return y
+
+
+def agreement_sync_then_raise(sample):
+    ok = 1 if len(sample) > 0 else 0
+    oks = multihost_utils.process_allgather(ok)
+    if min(oks) == 0:
+        raise ValueError("a rank had no rows - all ranks abort together")
+    return multihost_utils.process_allgather(sample)
+
+
+def participate_then_raise(sample, mapper_sync):
+    if len(sample) == 0:
+        mapper_sync(None)
+        raise ValueError("empty shard; peers were released first")
+    return mapper_sync(sample)
+
+
+def padded_gather(rows, per_rank):
+    n = len(rows)
+    if n < per_rank:
+        rows = np.pad(rows, (0, per_rank - n))
+    return multihost_utils.process_allgather(rows)
+
+
+def uniform_config_branch(x, cfg):
+    if cfg.force_row_wise:
+        return jax.lax.psum(x, "data")
+    return jax.lax.psum(x * 1, "data")
+
+
+def uniform_loop(x, num_rounds):
+    for _ in range(num_rounds):
+        x = jax.lax.psum(x, "data")
+    return x

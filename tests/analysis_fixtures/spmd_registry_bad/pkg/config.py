@@ -1,0 +1,5 @@
+"""Package-root marker: its presence arms the project-wide registry
+rules (COLL004 discovery) for this fixture directory. The docs tree is
+deliberately absent here, so the parameter-docs rule is silenced —
+a live file suppression SUP001 must accept."""
+# tpulint: disable-file=REG001

@@ -1,0 +1,6 @@
+"""An unregistered collective entry point: COLL004 discovery target."""
+from jax.experimental import multihost_utils
+
+
+def rogue_sync(values):
+    return multihost_utils.process_allgather(values)

@@ -1,0 +1,32 @@
+"""Stale-suppression fixture: SUP001 positives plus one live negative.
+
+Three dead comments (unknown rule id, dead line suppression, dead
+file-wide suppression) and one live LOCK001 suppression that must NOT
+be flagged.
+"""
+import threading
+
+import numpy as np
+
+# tpulint: disable-file=LOCK002
+
+
+def fine(x):
+    return np.asarray(x)  # tpulint: disable=NOPE123
+
+
+def also_fine(x):
+    return x + 1  # tpulint: disable=JIT003
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def peek(self):
+        return self._n  # tpulint: disable=LOCK001

@@ -394,3 +394,45 @@ def test_two_process_efb_matches_single(tmp_path):
     a, b = multi.predict(X[:512]), single.predict(X[:512])
     assert np.corrcoef(a, b)[0, 1] > 0.98
     assert np.mean(np.abs(a - b)) < 0.05
+
+
+def test_collective_manifest_entry_points_resolve():
+    """tpulint COLL004 registry: every collective entry point in
+    COLLECTIVE_MANIFEST must exist and carry a registered fault site.
+    The names asserted here are the ones the analyzer cross-checks
+    against this file — the host-collective surface of multihost
+    training: _allgather_find_mappers / _distributed_bin_mappers /
+    _streaming_mapper_sync (distributed bin finding), and the GBDT
+    sync points _setup_train, _setup_parallel, _sync_renewed_leaves,
+    _boost_from_average."""
+    from lightgbm_tpu.analysis.rules_spmd import COLLECTIVE_MANIFEST
+    from lightgbm_tpu.reliability.faults import KNOWN_SITES
+    import lightgbm_tpu.basic as basic
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.streaming.loader import build_streamed_dataset
+    from lightgbm_tpu.learner.grower import grow_tree
+    from lightgbm_tpu.learner.grower_mxu import grow_tree_mxu
+    from lightgbm_tpu.learner.histogram_mxu import quantize_gradients
+
+    resolvable = {
+        "_allgather_find_mappers": basic._allgather_find_mappers,
+        "_distributed_bin_mappers": basic._distributed_bin_mappers,
+        "_streaming_mapper_sync": basic._streaming_mapper_sync,
+        "build_streamed_dataset": build_streamed_dataset,
+        "_setup_train": GBDT._setup_train,
+        "_setup_parallel": GBDT._setup_parallel,
+        "_sync_renewed_leaves": GBDT._sync_renewed_leaves,
+        "_boost_from_average": GBDT._boost_from_average,
+        "grow_tree": grow_tree,
+        "grow_tree_mxu": grow_tree_mxu,
+        "quantize_gradients": quantize_gradients,
+    }
+    manifest_fns = {row[2] for row in COLLECTIVE_MANIFEST}
+    assert manifest_fns == set(resolvable), (
+        "COLLECTIVE_MANIFEST out of sync with the known collective "
+        "entry points")
+    for _, _, fn, site, mode, tests in COLLECTIVE_MANIFEST:
+        assert callable(resolvable[fn])
+        assert site in KNOWN_SITES, f"{fn}: unknown fault site {site}"
+        assert mode in ("body", "delegate", "dispatch")
+        assert tests, f"{fn}: no test file mapped"

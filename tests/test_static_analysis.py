@@ -25,9 +25,10 @@ FIXTURES = os.path.join(HERE, "analysis_fixtures")
 PACKAGE_DIR = os.path.dirname(os.path.abspath(lightgbm_tpu.__file__))
 
 ALL_RULE_IDS = (
+    "COLL001", "COLL002", "COLL003", "COLL004",
     "DTYPE001", "DTYPE002", "FAULT001", "JIT001", "JIT002", "JIT003",
     "JIT004", "LOCK001", "LOCK002", "PALLAS001", "REG001", "REG002",
-    "REG003", "REG004", "REG005",
+    "REG003", "REG004", "REG005", "SUP001",
 )
 
 
@@ -170,6 +171,76 @@ def test_fault_coverage_rule_fires():
 
 
 # ----------------------------------------------------------------------
+# SPMD collective-discipline rules (COLL001-COLL004) and the
+# stale-suppression self-check (SUP001)
+def test_spmd_rules_fire():
+    findings = run_on("spmd/coll_bad.py")
+    assert hits(findings) == {
+        ("COLL001", 15),  # branch_deadlock: psum on one arm only
+        ("COLL001", 22),  # loop_deadlock: rank-local trip count
+        ("COLL001", 29),  # cond_expr_deadlock: psum(x) if r > 0 else x
+        ("COLL002", 34),  # stranded_raise: bare raise, peers allgather
+        ("COLL002", 44),  # pr7_bin_parity: the PR-7 bug shape
+        ("COLL003", 50),  # ragged_gather: rows[:n] fed to allgather
+    }
+
+
+def test_pr7_bug_shape_is_caught():
+    # re-introducing the PR-7 stream_bin_parity bug (rank-guarded
+    # collective with a bare raise on the other arm) must be caught by
+    # COLL001 or COLL002
+    findings = run_on("spmd/coll_bad.py")
+    pr7 = [f for f in findings
+           if f.rule in ("COLL001", "COLL002")
+           and "pr7_bin_parity" in f.message]
+    assert pr7, "PR-7 bug shape not detected"
+
+
+def test_spmd_clean_fixture_is_silent():
+    # matching arms, agreement sync, participate-then-raise, np.pad to
+    # a static wire shape, and rank-uniform config branches/loops
+    assert run_on("spmd/coll_clean.py") == []
+
+
+def test_collective_registry_discovery_fires():
+    findings = run_on("spmd_registry_bad/pkg")
+    active = {(f.rule, os.path.basename(f.path), f.line)
+              for f in findings if not f.suppressed}
+    assert active == {("COLL004", "sync.py", 5)}
+    # the fixture's REG001 file-suppression is live, so SUP001 is quiet
+    assert not any(f.rule == "SUP001" for f in findings)
+
+
+def test_collective_manifest_covered_in_package():
+    # on the real package the manifest itself must be violation-free:
+    # no COLL004 finding at all (covered entries + no unregistered
+    # collective entry points)
+    findings = Analyzer().run([PACKAGE_DIR])
+    assert not [f for f in findings if f.rule == "COLL004"]
+
+
+def test_stale_suppression_self_check():
+    findings = run_on("stale_suppress.py")
+    sup = {(f.rule, f.line) for f in findings if f.rule == "SUP001"}
+    assert sup == {
+        ("SUP001", 11),   # disable-file=LOCK002 suppresses nothing
+        ("SUP001", 15),   # unknown rule id NOPE123
+        ("SUP001", 19),   # disable=JIT003 on a clean line
+    }
+    # the live LOCK001 suppression is honored, not flagged
+    assert {(f.rule, f.line, f.suppressed) for f in findings
+            if f.rule == "LOCK001"} == {("LOCK001", 32, True)}
+
+
+def test_full_package_analysis_wall_time():
+    import time
+    t0 = time.monotonic()
+    Analyzer().run([PACKAGE_DIR])
+    elapsed = time.monotonic() - t0
+    assert elapsed < 10.0, f"tpulint took {elapsed:.1f}s on the package"
+
+
+# ----------------------------------------------------------------------
 # CLI contract: module entry point, exit codes, JSON schema
 def _run_cli(*args):
     return subprocess.run(
@@ -192,6 +263,32 @@ def test_cli_exit_codes_and_json():
     clean = _run_cli(os.path.join(FIXTURES, "learner", "clean.py"))
     assert clean.returncode == 0
     assert "0 finding(s)" in clean.stdout
+
+
+def test_cli_sarif_format():
+    res = _run_cli(os.path.join(FIXTURES, "lock_bad.py"),
+                   "--format=sarif")
+    assert res.returncode == 1        # findings still set the exit code
+    doc = json.loads(res.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "tpulint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert rule_ids == set(ALL_RULE_IDS)
+    results = run["results"]
+    assert {r["ruleId"] for r in results} == {"LOCK001"}
+    assert {r["locations"][0]["physicalLocation"]["region"]["startLine"]
+            for r in results} == {17, 20}
+    assert all("suppressions" not in r for r in results)
+
+    # suppressed findings carry an inSource suppression record
+    sup = _run_cli(os.path.join(FIXTURES, "learner", "suppressed.py"),
+                   "--format=sarif")
+    assert sup.returncode == 0
+    sdoc = json.loads(sup.stdout)
+    sresults = sdoc["runs"][0]["results"]
+    assert sresults and all(
+        r["suppressions"] == [{"kind": "inSource"}] for r in sresults)
 
 
 def test_cli_list_rules():

@@ -334,6 +334,72 @@ class TestMapperSync:
         assert requested
         assert_binned_equal(from_raw_ref(X, y), ds._binned)
 
+    def test_empty_stream_joins_collective_before_raise(self):
+        # a rank whose partition yields no chunks hands None to the
+        # sync — joining the agreement collective — BEFORE raising, so
+        # peers fail identically instead of hanging in the allgather
+        # (tpulint COLL002, the PR-7 bug shape)
+        calls = []
+
+        def sync(sample):
+            calls.append(sample)
+            if sample is None:
+                raise LightGBMError("peer rank produced no sample rows")
+            return []
+
+        empty = PureStream(np.empty((0, 3)), np.empty(0), chunk_rows=64)
+        with pytest.raises(LightGBMError, match="no sample rows"):
+            build_streamed_dataset(empty, sample_rows=64,
+                                   mapper_sync=sync)
+        assert calls == [None]
+
+    def test_empty_stream_without_sync_raises_locally(self):
+        # single-process: no collective to join, plain loud failure
+        empty = PureStream(np.empty((0, 3)), np.empty(0), chunk_rows=64)
+        with pytest.raises(LightGBMError, match="yielded no chunks"):
+            build_streamed_dataset(empty, sample_rows=64)
+
+    def test_allgather_agreement_flags_empty_rank(self, monkeypatch):
+        # _allgather_find_mappers gathers one ok-flag per rank before
+        # any rows ship: a None sample aborts every rank with the same
+        # error, and no row gather ever starts
+        import lightgbm_tpu.basic as basic
+        from jax.experimental import multihost_utils
+        from lightgbm_tpu.config import Config
+        gathered = []
+
+        def fake_allgather(x):
+            gathered.append(np.asarray(x))
+            return np.asarray(x)[None]
+
+        monkeypatch.setattr(multihost_utils, "process_allgather",
+                            fake_allgather)
+        with pytest.raises(LightGBMError, match="no sample rows"):
+            basic._allgather_find_mappers(None, Config(), None)
+        assert len(gathered) == 1          # only the agreement flag
+        assert gathered[0].shape == ()
+
+    def test_allgather_agreement_then_rows(self, monkeypatch):
+        # healthy path: agreement flag first, then sizes + padded rows;
+        # the derived mappers match the local reference bit-for-bit
+        import lightgbm_tpu.basic as basic
+        from jax.experimental import multihost_utils
+        from lightgbm_tpu.binning import find_bin_mappers
+        from lightgbm_tpu.config import Config
+        X, _ = make_binary(n=300, f=4, seed=3)
+        Xd = np.asarray(X, np.float64)
+        monkeypatch.setattr(multihost_utils, "process_allgather",
+                            lambda x: np.asarray(x)[None])
+        cfg = Config({"bin_construct_sample_cnt": 300})
+        got = basic._allgather_find_mappers(Xd, cfg, None)
+        ref = find_bin_mappers(
+            Xd, max_bin=cfg.max_bin,
+            min_data_in_bin=cfg.min_data_in_bin, sample_cnt=300,
+            use_missing=cfg.use_missing,
+            zero_as_missing=cfg.zero_as_missing,
+            categorical_features=None, seed=cfg.data_random_seed)
+        assert [m.to_dict() for m in got] == [m.to_dict() for m in ref]
+
     def test_bin_parity_rejected_under_multihost(self):
         # per-rank coverage failures would strand peers inside the
         # mapper collective, so the combination fails fast on all ranks
