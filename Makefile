@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-all faults observe lint lint-sarif pipeline kernels stream bench install
+.PHONY: test test-slow test-all faults chaos observe lint lint-sarif pipeline kernels stream bench install
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -51,6 +51,13 @@ stream:
 # recovered (tests/test_reliability.py, docs/Reliability.md)
 faults:
 	$(PY) -m pytest tests/ -x -q -m faults
+
+# the rank-death chaos tier: 2-rank run loses a rank mid-collective,
+# survivor aborts with a named diagnostic, resume is byte-identical
+# (tests/test_chaos.py, docs/Reliability.md "Distributed fault model");
+# the trailing -m overrides pytest.ini's `not slow`
+chaos:
+	$(PY) -m pytest tests/test_chaos.py -x -q -m chaos
 
 # the observability tier: spans, training telemetry, MFU accounting,
 # Prometheus /metrics (tests/test_observability.py, docs/Observability.md)

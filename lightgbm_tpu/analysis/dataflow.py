@@ -51,13 +51,14 @@ RANK_SOURCES = frozenset({"process_index", "axis_index", "host_id"})
 
 #: collective entry points: every rank must reach these together, and
 #: their results are rank-uniform (taint-laundering). Includes the
-#: package's own named collective wrappers (basic._allgather_find_mappers
-#: and the loader's mapper_sync hook) so rules see them as collectives.
+#: package's own named collective wrappers (basic._allgather_find_mappers,
+#: the loader's mapper_sync hook and the watchdog-bracketed
+#: parallel.comm.guarded_allgather) so rules see them as collectives.
 COLLECTIVE_CALLABLES = frozenset({
     "psum", "psum_scatter", "pmean", "pmax", "pmin", "all_gather",
     "all_to_all", "ppermute", "process_allgather",
     "broadcast_one_to_all", "sync_global_devices",
-    "_allgather_find_mappers", "mapper_sync",
+    "_allgather_find_mappers", "mapper_sync", "guarded_allgather",
 })
 
 #: constructors that produce a statically-shaped array regardless of

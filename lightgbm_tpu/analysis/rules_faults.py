@@ -33,6 +33,7 @@ DISPATCH_MANIFEST = (
     ("engine.py", "predict_raw", "serving_device_predict"),
     ("checkpoint.py", "save_checkpoint", "checkpoint_io"),
     ("loader.py", "_ingest_chunk_step", "streaming_ingest"),
+    ("comm.py", "guarded_allgather", "collective_psum"),
 )
 
 #: wrapper function -> the site its body injects
@@ -40,6 +41,7 @@ SITE_WRAPPERS = {
     "_maybe_inject_fused_fault": "fused_dispatch",
     "check_collective_fault": "collective_psum",
     "_ingest_chunk_step": "streaming_ingest",
+    "guarded_allgather": "collective_psum",
 }
 
 #: manifest basenames that are ambiguous in the package (engine.py
@@ -50,6 +52,7 @@ _DIR_HINTS = {
     ("gbdt.py", "train_many_dispatch"): "boosting",
     ("gbdt.py", "_grow"): "boosting",
     ("loader.py", "_ingest_chunk_step"): "streaming",
+    ("comm.py", "guarded_allgather"): "parallel",
 }
 
 

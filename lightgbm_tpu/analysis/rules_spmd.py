@@ -293,6 +293,10 @@ class CollectiveShapeRule(Rule):
 #: "dispatch" — a device collective whose site fires at the dispatch
 #: boundary (rules_faults.DISPATCH_MANIFEST carries the site).
 COLLECTIVE_MANIFEST = (
+    ("comm.py", "parallel", "guarded_allgather", "collective_psum",
+     "body", ("test_watchdog.py", "test_multihost.py")),
+    ("comm.py", "parallel", "checkpoint_agree", "collective_psum",
+     "delegate", ("test_checkpoint.py", "test_multihost.py")),
     ("basic.py", None, "_allgather_find_mappers", "collective_psum",
      "body", ("test_multihost.py", "test_streaming.py")),
     ("basic.py", None, "_distributed_bin_mappers", "collective_psum",

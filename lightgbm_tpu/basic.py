@@ -190,15 +190,15 @@ def _allgather_find_mappers(sample, cfg, cat, sparse_in=False):
     strand peers in the collective (tpulint COLL002, the PR-7
     stream_bin_parity bug shape)."""
     import jax
-    from jax.experimental import multihost_utils
     from .binning import find_bin_mappers
-    from .parallel.comm import check_collective_fault
-    check_collective_fault()
+    from .parallel.comm import guarded_allgather
+    from .reliability.watchdog import maybe_start_watchdog
+    maybe_start_watchdog(cfg)
     nproc = jax.process_count()
     # agreement sync: gather one ok-flag per rank before any rank ships
     # rows, so validation failure is raised identically everywhere
     ok = np.asarray(0 if sample is None else 1, np.int64)
-    oks = np.asarray(multihost_utils.process_allgather(ok)).reshape(-1)
+    oks = guarded_allgather(ok, label="bin_mapper_agree").reshape(-1)
     if int(oks.min(initial=1)) == 0:
         bad = [r for r in range(oks.shape[0]) if int(oks[r]) == 0]
         raise LightGBMError(
@@ -223,9 +223,9 @@ def _allgather_find_mappers(sample, cfg, cat, sparse_in=False):
     sample = np.ascontiguousarray(sample, dtype=np.float64)
     if n_samp < per:
         sample = np.pad(sample, ((0, per - n_samp), (0, 0)))
-    sizes = np.asarray(multihost_utils.process_allgather(
-        np.asarray(n_samp, np.int64)))
-    gathered = np.asarray(multihost_utils.process_allgather(sample))
+    sizes = guarded_allgather(np.asarray(n_samp, np.int64),
+                              label="bin_mapper_sizes")
+    gathered = guarded_allgather(sample, label="bin_mapper_rows")
     union = np.concatenate(
         [gathered[r, :int(sizes[r])] for r in range(nproc)])
     return find_bin_mappers(

@@ -124,15 +124,13 @@ class GBDT:
                 # mapper construction (dataset_loader.cpp:722-807):
                 # deterministic fixed-size local row sample -> allgather
                 # -> every rank plans over the identical pooled sample.
-                from jax.experimental import multihost_utils
-                from ..parallel.comm import check_collective_fault
-                check_collective_fault()
+                from ..parallel.comm import guarded_allgather
                 k_samp = max(1, 20000 // nproc_now)
                 rs = np.random.RandomState(13)
                 n_loc = plan_bins.shape[0]
                 idx = rs.choice(n_loc, k_samp, replace=n_loc < k_samp)
-                pooled = np.asarray(multihost_utils.process_allgather(
-                    np.ascontiguousarray(plan_bins[np.sort(idx)])))
+                pooled = guarded_allgather(plan_bins[np.sort(idx)],
+                                           label="efb_plan_sample")
                 plan_bins = pooled.reshape(-1, plan_bins.shape[1])
             plan = build_plan(plan_bins, ds.num_bins,
                               ds.default_bins,
@@ -422,6 +420,9 @@ class GBDT:
         from ..parallel.learner import make_sharded_grower
         from jax.sharding import NamedSharding, PartitionSpec as P
         self._nproc = jax.process_count()
+        if self._nproc > 1:
+            from ..reliability.watchdog import maybe_start_watchdog
+            maybe_start_watchdog(cfg)
         if self._nproc > 1 and cfg.tree_learner != "data":
             raise ValueError(
                 "multi-machine training supports tree_learner=data "
@@ -436,11 +437,10 @@ class GBDT:
                 # global shape is inferred from the local shard, so all
                 # machines pad to the LARGEST partition (padded rows
                 # carry zero grad/hess/count — they contribute nothing)
-                from jax.experimental import multihost_utils
-                from ..parallel.comm import check_collective_fault
-                check_collective_fault()
-                sizes = np.asarray(multihost_utils.process_allgather(
-                    np.asarray(self.num_data, np.int64)))
+                from ..parallel.comm import guarded_allgather
+                sizes = guarded_allgather(
+                    np.asarray(self.num_data, np.int64),
+                    label="row_pad_sizes")
                 target = int(-(-int(sizes.max()) // ndev_local)
                              * ndev_local)
                 self._row_pad = target - self.num_data
@@ -703,10 +703,22 @@ class GBDT:
 
         def _attempt():
             faults.inject("histogram_build")
-            if self._grower is not None:
-                from ..parallel.comm import check_collective_fault
-                check_collective_fault()
-            return self._grow_impl(g, h, cnt, feature_mask)
+            if self._grower is None:
+                return self._grow_impl(g, h, cnt, feature_mask)
+            from ..parallel.comm import check_collective_fault
+            from ..reliability.watchdog import active_guard
+            check_collective_fault()
+            guard = active_guard()
+            if guard is None:
+                return self._grow_impl(g, h, cnt, feature_mask)
+            # JAX dispatch is async: a peer dying mid-psum hangs the
+            # host at the first result *read*, not the launch — so the
+            # deadline bracket must cover block_until_ready, or the
+            # watchdog would never see the stall
+            with guard.guard("sharded_grow"):
+                out = self._grow_impl(g, h, cnt, feature_mask)
+                jax.block_until_ready(out)
+            return out
 
         return retry_call(_attempt, attempts=cfg.retry_max_attempts,
                           backoff_ms=cfg.retry_backoff_ms,
@@ -788,7 +800,6 @@ class GBDT:
         serial_tree_learner.cpp:747-757): each rank renews from its
         local percentiles; the final leaf value is the mean of the
         per-rank values over ranks that hold in-bag rows in the leaf."""
-        from jax.experimental import multihost_utils
         m1 = tree.leaf_value.shape[0]
         cnts = np.zeros(m1, np.float64)
         np.add.at(cnts, np.asarray(row_node),
@@ -796,10 +807,9 @@ class GBDT:
         lv = np.asarray(tree.leaf_value, np.float64)
         has = (cnts > 0).astype(np.float64)
         contrib = np.stack([np.where(has > 0, lv, 0.0), has])
-        from ..parallel.comm import check_collective_fault
-        check_collective_fault()
-        total = np.asarray(multihost_utils.process_allgather(
-            np.ascontiguousarray(contrib))).sum(axis=0)
+        from ..parallel.comm import guarded_allgather
+        total = guarded_allgather(
+            contrib, label="leaf_renewal_sync").sum(axis=0)
         nz = np.maximum(total[1], 1.0)
         synced = np.where(total[1] > 0, total[0] / nz, lv)
         is_leaf = np.asarray(tree.is_leaf)
@@ -1504,11 +1514,9 @@ class GBDT:
             # reference gbdt.cpp:335-344: init scores are averaged across
             # machines (GlobalSyncUpByMean), each rank having computed
             # from its local partition
-            from jax.experimental import multihost_utils
-            from ..parallel.comm import check_collective_fault
-            check_collective_fault()
-            init = float(np.mean(multihost_utils.process_allgather(
-                np.float32(init))))
+            from ..parallel.comm import guarded_allgather
+            init = float(np.mean(guarded_allgather(
+                np.float32(init), label="boost_from_average")))
         if abs(init) > 1e-35:
             self._add_const_score(init, cls)
             Log.info("Start training from score %f", init)

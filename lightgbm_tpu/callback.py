@@ -150,8 +150,20 @@ def checkpoint(period: int, directory: str, keep_last: int = 3) -> Callable:
         try:
             state, arrays = booster._training_state()
             state["eval_history"] = history
+            # multihost runs checkpoint through the coordinated commit
+            # protocol: every rank reaches this callback at the same
+            # iteration (same data cadence), agrees on it, and writes
+            # its own shard — rank 0 cuts the COMMIT marker last
+            coord = None
+            gb = getattr(booster, "gbdt", None)
+            cfg = getattr(gb, "config", None)
+            if gb is not None and getattr(gb, "_nproc", 1) > 1 and \
+                    getattr(cfg, "checkpoint_coordinated", True):
+                from .parallel.comm import checkpoint_coordinator
+                coord = checkpoint_coordinator()
             save_checkpoint(directory, done, booster.model_to_string(),
-                            state, arrays, keep_last=keep_last)
+                            state, arrays, keep_last=keep_last,
+                            coordinator=coord)
         except Exception as exc:
             counters.inc("checkpoint_failures")
             Log.warning(

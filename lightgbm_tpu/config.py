@@ -234,6 +234,31 @@ _PARAMS: List[ParamSpec] = [
     _p("retry_backoff_ms", float, 50.0, ("retry_base_backoff_ms",),
        lambda v: v >= 0),
     _p("retry_backoff_max_ms", float, 2000.0, (), lambda v: v >= 0),
+    _p("collective_timeout_s", float, 0.0, ("collective_deadline_s",),
+       lambda v: v >= 0,
+       desc="collective-watchdog deadline: a multihost run whose "
+            "host-boundary collective (allgather, sharded growth psum) "
+            "blocks longer than this aborts the local process with a "
+            "'rank k last seen Ns ago' diagnostic instead of hanging "
+            "forever on a dead peer. 0 (default) disables the watchdog; "
+            "it is always off on a single machine. The first collective "
+            "of each kind gets 4x this deadline to absorb XLA "
+            "compilation (docs/Reliability.md)"),
+    _p("heartbeat_interval_s", float, 1.0, (), lambda v: v > 0,
+       desc="how often each rank stamps its liveness file while the "
+            "collective watchdog is armed; a peer is reported stale "
+            "after ~3 missed intervals"),
+    _p("heartbeat_dir", str, "", (),
+       desc="shared directory for the watchdog's per-rank heartbeat "
+            "files; defaults to <checkpoint_dir>/heartbeats when a "
+            "checkpoint_dir is set, else heartbeat diagnosis is "
+            "disabled (deadline aborts still fire, unnamed)"),
+    _p("checkpoint_coordinated", bool, True, (),
+       desc="multihost checkpointing runs the coordinated commit "
+            "protocol (iteration agreement, per-rank shards, COMMIT "
+            "marker — docs/Reliability.md). Disable to fall back to "
+            "rank-independent single-host bundles (not resumable "
+            "across ranks)"),
     # ---- Convert (config.h:1006-1020) ----
     _p("convert_model_language", str, ""),
     _p("convert_model", str, "gbdt_prediction.cpp",
@@ -536,6 +561,14 @@ class Config:
                 "checkpoint_period > 0 needs checkpoint_dir; "
                 "checkpointing disabled")
             self.checkpoint_period = 0
+        if self.collective_timeout_s > 0 and self.num_machines <= 1:
+            # not an error: the same config file may serve both the
+            # launcher and a local smoke run — but say clearly that the
+            # watchdog only arms with real peers
+            from .utils.log import Log
+            Log.warning(
+                "collective_timeout_s is set but num_machines <= 1; "
+                "the collective watchdog only arms on multihost runs")
         if (self.observe_trace_file or self.observe_norms or
                 self.observe_metrics_port > 0) and not self.observe:
             # asking for an observability output implies observing

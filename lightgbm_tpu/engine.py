@@ -64,7 +64,19 @@ def train(params: Dict[str, Any], train_set: Dataset,
             raise ValueError("resume_from and init_model are exclusive: "
                              "a checkpoint bundle already carries its model")
         from .reliability.checkpoint import load_checkpoint
-        resume_state = load_checkpoint(resume_from)
+        # under multihost (setup_multihost ran before train, like the
+        # reference CLI) each rank loads its own shard of a coordinated
+        # bundle; world validation rejects topology changes
+        import jax
+        try:
+            _world = jax.process_count()
+        except RuntimeError:
+            _world = 1
+        if _world > 1:
+            resume_state = load_checkpoint(
+                resume_from, rank=jax.process_index(), world=_world)
+        else:
+            resume_state = load_checkpoint(resume_from)
         init_model = None
     if fobj is not None:
         params["objective"] = "none"

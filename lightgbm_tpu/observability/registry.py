@@ -63,6 +63,12 @@ class ObservabilityRegistry:
         # _resolved_hist_backend): the pinned choice + autotune timings
         self._hist_backend = {"choice": "", "autotuned": False,
                               "timings_ms": {}}
+        # collective-watchdog aggregates (reliability/watchdog.py):
+        # guarded brackets, deadline overruns, aborts and the worst
+        # peer heartbeat age observed while diagnosing
+        self._collective = {"guarded": 0, "wall_seconds": 0.0,
+                            "timeouts": 0, "aborts": 0,
+                            "heartbeat_age_max_s": 0.0, "world": 0}
         # shared singletons, NOT copies — existing call sites in
         # serving/, reliability/ and the phase timeits keep writing to
         # the same objects this registry reads.
@@ -102,6 +108,9 @@ class ObservabilityRegistry:
                                "exact": 0}
             self._hist_backend = {"choice": "", "autotuned": False,
                                   "timings_ms": {}}
+            self._collective = {"guarded": 0, "wall_seconds": 0.0,
+                                "timeouts": 0, "aborts": 0,
+                                "heartbeat_age_max_s": 0.0, "world": 0}
 
     # -- exporters ------------------------------------------------------
     def pipeline_snapshot(self) -> Dict:
@@ -139,9 +148,17 @@ class ObservabilityRegistry:
             out[str(name) + "_ms"] = round(float(ms), 3)
         return out
 
+    def collective_snapshot(self) -> Dict:
+        with self._lock:
+            c = dict(self._collective)
+        c["wall_seconds"] = round(c["wall_seconds"], 6)
+        c["heartbeat_age_max_s"] = round(c["heartbeat_age_max_s"], 3)
+        return c
+
     def snapshot(self) -> Dict:
         return {
             "enabled": self.enabled,
+            "collective": self.collective_snapshot(),
             "hist_backend": self.hist_backend_snapshot(),
             "pipeline": self.pipeline_snapshot(),
             "streaming": self.streaming_snapshot(),
@@ -167,6 +184,7 @@ class ObservabilityRegistry:
             (snap["compiles"], "lightgbm_tpu_compiles", None),
             (snap["device_utilization"], "lightgbm_tpu_device", None),
             (snap["counters"], "lightgbm_tpu_reliability", None),
+            (snap["collective"], "lightgbm_tpu_collective", None),
             (snap["hist_backend"], "lightgbm_tpu_hist_backend", None),
             (snap["pipeline"], "lightgbm_tpu_pipeline", None),
             (snap["streaming"], "lightgbm_tpu_streaming", None),
@@ -189,6 +207,32 @@ class ObservabilityRegistry:
                 "choice": str(choice), "autotuned": bool(autotuned),
                 "timings_ms": {str(k): float(v)
                                for k, v in (timings_ms or {}).items()}}
+
+    # -- collective-watchdog hooks (reliability/watchdog.py) ------------
+    # recorded even when disabled, like record_hist_autotune: watchdog
+    # events are rare, high-value incident forensics — the last thing
+    # the run prints before aborting must not depend on an enable flag
+    def record_collective_guard(self, wall_seconds: float) -> None:
+        with self._lock:
+            self._collective["guarded"] += 1
+            self._collective["wall_seconds"] += float(wall_seconds)
+
+    def record_collective_timeout(self) -> None:
+        with self._lock:
+            self._collective["timeouts"] += 1
+
+    def record_collective_abort(self) -> None:
+        with self._lock:
+            self._collective["aborts"] += 1
+
+    def record_heartbeat_age(self, age_s: float) -> None:
+        with self._lock:
+            self._collective["heartbeat_age_max_s"] = max(
+                self._collective["heartbeat_age_max_s"], float(age_s))
+
+    def record_collective_world(self, world: int) -> None:
+        with self._lock:
+            self._collective["world"] = int(world)
 
     def tree_macs_for(self, gbdt) -> int:
         """Analytic per-tree MAC estimate for this booster's config;

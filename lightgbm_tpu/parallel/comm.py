@@ -21,7 +21,11 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
-__all__ = ["CommSpec", "check_collective_fault"]
+import numpy as np
+
+__all__ = ["CommSpec", "check_collective_fault", "guarded_allgather",
+           "checkpoint_agree", "checkpoint_coordinator",
+           "CheckpointCoordinator"]
 
 
 def check_collective_fault() -> None:
@@ -35,6 +39,64 @@ def check_collective_fault() -> None:
     with the caller (reliability/retry.py)."""
     from ..reliability import faults
     faults.inject("collective_psum")
+
+
+def guarded_allgather(x, label: str = "allgather") -> np.ndarray:
+    """THE host-boundary allgather: every cross-process gather in the
+    library funnels through here so one choke point carries both the
+    `collective_psum` fault site (rank_death chaos schedules included)
+    and the collective-watchdog deadline bracket. A peer that died
+    before this call leaves us blocked inside `process_allgather`; the
+    watchdog deadline turns that into a named "rank k last seen Ns ago"
+    abort instead of an eternal hang."""
+    from jax.experimental import multihost_utils
+    from ..reliability.watchdog import collective_guard
+    check_collective_fault()
+    arr = np.asarray(x)
+    if arr.ndim:        # ascontiguousarray would promote 0-d to 1-d,
+        arr = np.ascontiguousarray(arr)   # changing the wire shape
+
+    with collective_guard(label):
+        return np.asarray(multihost_utils.process_allgather(arr))
+
+
+def checkpoint_agree(value: int, label: str = "checkpoint_agree"
+                     ) -> np.ndarray:
+    """One-int agreement collective (the PR-8 agreement-flag idiom):
+    every rank contributes `value`, every rank sees all of them, and
+    all can decide identically — used by the coordinated checkpoint
+    protocol to agree on the iteration to snapshot and on shard-write
+    success before the commit marker is cut. Delegates to
+    `guarded_allgather`, inheriting its fault site and watchdog
+    bracket."""
+    out = guarded_allgather(np.asarray([int(value)], dtype=np.int64),
+                            label=label)
+    return out.reshape(-1)
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointCoordinator:
+    """The handle `save_checkpoint` uses to run the multihost commit
+    protocol. Exists only when >1 process participates — single-host
+    saves keep the original (and cheaper) tmp+rename path."""
+    rank: int
+    world: int
+
+    def agree(self, value: int, label: str = "checkpoint_agree"):
+        return checkpoint_agree(value, label=label)
+
+
+def checkpoint_coordinator() -> Optional[CheckpointCoordinator]:
+    """A `CheckpointCoordinator` for this run, or None on one process
+    (coordination degenerates to nothing — no collectives issued)."""
+    import jax
+    try:
+        world = jax.process_count()
+    except RuntimeError:
+        world = 1
+    if world <= 1:
+        return None
+    return CheckpointCoordinator(rank=jax.process_index(), world=world)
 
 
 @dataclasses.dataclass(frozen=True)

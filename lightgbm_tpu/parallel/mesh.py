@@ -73,12 +73,34 @@ def default_mesh(axis: str = "data") -> Mesh:
     return make_mesh(0, axis)
 
 
+def _enable_cpu_collectives() -> None:
+    """Cross-process computations on the CPU backend need a real
+    collectives implementation — with the default ("none") every
+    multi-process jit/allgather fails with "Multiprocess computations
+    aren't implemented on the CPU backend". jaxlib ships gloo; select
+    it before the backend initializes. Only applies when the process
+    is pinned to CPU (multi-process CPU tests, the chaos harness);
+    TPU runs keep the default ICI/DCN transport."""
+    plat = os.environ.get("JAX_PLATFORMS") or ""
+    try:
+        plat = plat or (jax.config.jax_platforms or "")
+    except AttributeError:
+        pass
+    if "cpu" not in plat:
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, KeyError, ValueError):
+        pass    # older jax: no such config (and no CPU collectives)
+
+
 def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None) -> None:
     """Multi-host initialization (reference Network::Init + machine list;
     here jax.distributed handles rendezvous over DCN)."""
     if coordinator_address is not None:
+        _enable_cpu_collectives()
         jax.distributed.initialize(coordinator_address, num_processes,
                                    process_id)
 
@@ -185,4 +207,5 @@ def setup_multihost(num_machines: int, machines: str = "",
                 "LIGHTGBM_TPU_MACHINE_RANK" % len(matches))
         rank = matches[0]
     coordinator = f"{entries[0][0]}:{entries[0][1]}"
+    _enable_cpu_collectives()
     jax.distributed.initialize(coordinator, num_machines, rank)

@@ -1,33 +1,47 @@
 """Fault tolerance for training and serving.
 
-Three pillars (see docs/Reliability.md):
+Four pillars (see docs/Reliability.md):
 
 - checkpoint/resume: atomic training-state bundles + `train(...,
   resume_from=)` so a killed run resumes to a model byte-identical to
-  an uninterrupted one (`reliability.checkpoint`);
+  an uninterrupted one; multihost runs commit bundles through a
+  coordinated agree/shard/COMMIT protocol (`reliability.checkpoint`);
 - unified fault injection: a registry of named sites with deterministic
-  skip/fail schedules, the single lever robustness tests pull
-  (`reliability.faults`);
+  skip/fail schedules — including a ``rank_death`` mode that kills the
+  whole process for chaos testing — the single lever robustness tests
+  pull (`reliability.faults`);
 - guard rails + retry: non-finite detection with configurable policy,
   and capped-exponential-backoff retries at device dispatch boundaries
-  (`reliability.guards`, `reliability.retry`).
+  (`reliability.guards`, `reliability.retry`);
+- collective watchdog: deadline + heartbeat bracketing of host-boundary
+  collectives, so a dead rank is diagnosed ("rank k last seen Ns ago")
+  and survivors abort cleanly instead of hanging forever
+  (`reliability.watchdog`).
 
 Every recovery is counted (`reliability.counters`) so degradation shows
 up in the bench JSON record and the serving metrics snapshot.
 """
 
 from .counters import ReliabilityCounters, counters
-from .faults import FaultRegistry, InjectedFault, KNOWN_SITES, faults
+from .faults import (FaultRegistry, InjectedFault, KNOWN_SITES,
+                     RANK_DEATH_EXIT_CODE, faults)
 from .guards import GUARD_POLICIES, GuardError
 from .retry import retry_call
 from .checkpoint import (CheckpointState, latest_checkpoint,
                          load_checkpoint, save_checkpoint)
+from .watchdog import (CollectiveGuard, WATCHDOG_EXIT_CODE, active_guard,
+                       collective_guard, configure_watchdog,
+                       maybe_start_watchdog, shutdown_watchdog)
 
 __all__ = [
     "ReliabilityCounters", "counters",
-    "FaultRegistry", "InjectedFault", "KNOWN_SITES", "faults",
+    "FaultRegistry", "InjectedFault", "KNOWN_SITES",
+    "RANK_DEATH_EXIT_CODE", "faults",
     "GUARD_POLICIES", "GuardError",
     "retry_call",
     "CheckpointState", "latest_checkpoint", "load_checkpoint",
     "save_checkpoint",
+    "CollectiveGuard", "WATCHDOG_EXIT_CODE", "active_guard",
+    "collective_guard", "configure_watchdog", "maybe_start_watchdog",
+    "shutdown_watchdog",
 ]

@@ -7,7 +7,7 @@ resumed run to reproduce an uninterrupted one. Layout::
 
     <dir>/ckpt_0000012/          # iteration 12 has been trained
         model.txt                # Booster.model_to_string()
-        state.json               # iteration, flags, eval history, ...
+        state.json               # iteration, world_size, eval history...
         arrays.npz               # train_score, rng_key, bag_mask, ...
     <dir>/LATEST                 # name of the newest complete bundle
 
@@ -16,6 +16,19 @@ dot-prefixed temp name and `os.rename`d into place (POSIX rename is
 atomic within a filesystem), and LATEST is rewritten via `os.replace`.
 A crash mid-write leaves only a `.tmp-*` turd that the next save
 sweeps; readers never observe a partial bundle.
+
+Multihost runs use a *coordinated* variant of the same layout (pass a
+`parallel.comm.CheckpointCoordinator` to `save_checkpoint`): ranks
+first agree on the iteration via a one-int allgather (the PR-8
+agreement-flag idiom), then every rank writes its own
+``shard_<rank>.npz`` into the shared bundle directory while rank 0
+writes ``model.txt`` + ``state.json``, then a second one-int agreement
+confirms every shard landed, and only then does rank 0 cut the
+``COMMIT`` marker and advance LATEST. A rank dying anywhere in the
+middle leaves a marker-less bundle that `latest_checkpoint` refuses to
+return — the multihost extension of PR 7's torn-state detection.
+Single-host bundles never carry a COMMIT file (completeness there is
+the directory rename itself), so their layout is unchanged.
 
 The reference's closest analog is continued training from a saved model
 (`engine.py` init_model) — but that path re-seeds init scores through a
@@ -38,12 +51,16 @@ from .counters import counters
 from .faults import faults
 
 __all__ = ["CheckpointState", "save_checkpoint", "load_checkpoint",
-           "latest_checkpoint", "FORMAT_VERSION"]
+           "latest_checkpoint", "FORMAT_VERSION", "COMMIT_MARKER"]
 
 FORMAT_VERSION = 1
 
 _BUNDLE_PREFIX = "ckpt_"
 _LATEST = "LATEST"
+#: presence of this file inside a bundle written by >1 rank is the
+#: commit point of the coordinated save protocol; bundles that declare
+#: world_size > 1 in state.json but lack it are partial and ignored
+COMMIT_MARKER = "COMMIT"
 
 
 @dataclass
@@ -69,19 +86,75 @@ def _bundle_iter(name: str) -> Optional[int]:
         return None
 
 
+def _listdir(path: str) -> List[str]:
+    """os.listdir that treats a vanished directory as empty — another
+    rank (or a killed process) may remove it mid-scan."""
+    try:
+        return os.listdir(path)
+    except (FileNotFoundError, NotADirectoryError):
+        return []
+
+
 def _sweep_tmp(ckpt_dir: str) -> None:
-    for name in os.listdir(ckpt_dir):
+    # coordinated ranks write through in-bundle tmp files, never
+    # top-level `.tmp-*` dirs, so concurrent sweeps cannot eat a peer's
+    # in-flight work; a racing unlink just means someone swept first
+    for name in _listdir(ckpt_dir):
         if name.startswith(".tmp-"):
-            shutil.rmtree(os.path.join(ckpt_dir, name), ignore_errors=True)
+            shutil.rmtree(os.path.join(ckpt_dir, name),
+                          ignore_errors=True)
+
+
+def _read_state(bundle: str) -> Optional[Dict]:
+    try:
+        with open(os.path.join(bundle, "state.json")) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _is_complete(bundle: str) -> bool:
+    """True when `bundle` is safe to resume from. Single-writer bundles
+    (world_size absent or <= 1) are complete by construction — they
+    became visible via an atomic directory rename. Coordinated bundles
+    additionally need the COMMIT marker: every shard confirmed."""
+    state = _read_state(bundle)
+    if state is None:
+        return False
+    if int(state.get("world_size", 1)) <= 1:
+        return True
+    return os.path.isfile(os.path.join(bundle, COMMIT_MARKER))
+
+
+def _write_text_atomic(bundle: str, name: str, text: str) -> None:
+    tmp = os.path.join(bundle, f"{name}.tmp-{os.getpid()}")
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, os.path.join(bundle, name))
+
+
+def _write_npz_atomic(bundle: str, name: str,
+                      arrays: Dict[str, np.ndarray]) -> None:
+    tmp = os.path.join(bundle, f"{name}.tmp-{os.getpid()}")
+    # hand savez a file object, not the tmp path: given a path without
+    # a .npz suffix it would append one and break the os.replace
+    with open(tmp, "wb") as f:
+        np.savez(f, **{k: np.asarray(v) for k, v in arrays.items()})
+    os.replace(tmp, os.path.join(bundle, name))
 
 
 def save_checkpoint(ckpt_dir: str, iteration: int, model_str: str,
                     state: Dict, arrays: Dict[str, np.ndarray],
-                    keep_last: int = 0) -> str:
+                    keep_last: int = 0, coordinator=None) -> str:
     """Write one atomic bundle; returns its path.
 
     `keep_last` > 0 prunes older bundles after the new one is visible,
-    so the retention floor never drops below the newest snapshot."""
+    so the retention floor never drops below the newest snapshot.
+    Passing a `CheckpointCoordinator` switches to the multihost commit
+    protocol (module docstring) — every rank must call with one."""
+    if coordinator is not None and coordinator.world > 1:
+        return _save_coordinated(ckpt_dir, iteration, model_str, state,
+                                 arrays, keep_last, coordinator)
     os.makedirs(ckpt_dir, exist_ok=True)
     _sweep_tmp(ckpt_dir)
     name = _bundle_name(iteration)
@@ -93,7 +166,8 @@ def save_checkpoint(ckpt_dir: str, iteration: int, model_str: str,
     os.makedirs(tmp, exist_ok=True)
     with open(os.path.join(tmp, "model.txt"), "w") as f:
         f.write(model_str)
-    full_state = {"format_version": FORMAT_VERSION, "iteration": int(iteration)}
+    full_state = {"format_version": FORMAT_VERSION,
+                  "iteration": int(iteration), "world_size": 1}
     full_state.update(state)
     with open(os.path.join(tmp, "state.json"), "w") as f:
         json.dump(full_state, f, indent=1, sort_keys=True)
@@ -116,22 +190,106 @@ def save_checkpoint(ckpt_dir: str, iteration: int, model_str: str,
     return final
 
 
+def _save_coordinated(ckpt_dir: str, iteration: int, model_str: str,
+                      state: Dict, arrays: Dict[str, np.ndarray],
+                      keep_last: int, coord) -> str:
+    """The multihost commit protocol. Collective layout (every rank
+    runs the SAME sequence, or peers strand — tpulint COLL002):
+
+        agree(iteration)  ->  write own shard  ->  agree(ok)
+                                                        |
+                       rank 0 only:  COMMIT + LATEST + prune
+
+    Rank-local write failures are caught and voted into the second
+    agreement instead of raised, so all ranks raise the same error
+    together and the marker-less bundle is discarded on resume."""
+    rank, world = int(coord.rank), int(coord.world)
+    its = np.asarray(coord.agree(int(iteration),
+                                 label="checkpoint_agree")).reshape(-1)
+    agreed = int(its.min())
+    if int(its.max()) != agreed:
+        raise LightGBMError(
+            f"coordinated checkpoint: ranks disagree on the iteration "
+            f"to snapshot ({sorted(set(int(i) for i in its))}) — "
+            f"callback periods must be identical on every rank")
+    name = _bundle_name(agreed)
+    final = os.path.join(ckpt_dir, name)
+    ok = 1
+    try:
+        faults.inject("checkpoint_io")
+        os.makedirs(final, exist_ok=True)
+        _write_npz_atomic(final, f"shard_{rank:03d}.npz", arrays)
+        if rank == 0:
+            _write_text_atomic(final, "model.txt", model_str)
+            full_state = {"format_version": FORMAT_VERSION,
+                          "iteration": agreed, "world_size": world}
+            full_state.update(state)
+            _write_text_atomic(final, "state.json",
+                               json.dumps(full_state, indent=1,
+                                          sort_keys=True))
+    except Exception as exc:
+        Log.warning("coordinated checkpoint: rank %d failed to write "
+                    "its shard for iteration %d (%s: %s)", rank, agreed,
+                    type(exc).__name__, exc)
+        ok = 0
+    oks = np.asarray(coord.agree(ok,
+                                 label="checkpoint_commit")).reshape(-1)
+    if int(oks.min(initial=1)) == 0:
+        bad = [r for r in range(oks.shape[0]) if int(oks[r]) == 0]
+        raise LightGBMError(
+            f"coordinated checkpoint at iteration {agreed} failed on "
+            f"rank(s) {bad}; bundle left uncommitted (ignored on "
+            f"resume)")
+    if rank == 0:
+        _write_text_atomic(final, COMMIT_MARKER,
+                           f"iteration={agreed} world_size={world}\n")
+        latest_tmp = os.path.join(ckpt_dir, _LATEST + ".tmp")
+        with open(latest_tmp, "w") as f:
+            f.write(name + "\n")
+        os.replace(latest_tmp, os.path.join(ckpt_dir, _LATEST))
+        if keep_last and keep_last > 0:
+            _prune(ckpt_dir, keep_last)
+    counters.inc("checkpoint_saves")
+    Log.info(f"checkpoint: rank {rank}/{world} committed iteration "
+             f"{agreed} -> {final}")
+    return final
+
+
 def _prune(ckpt_dir: str, keep_last: int) -> None:
-    bundles: List[int] = []
-    for name in os.listdir(ckpt_dir):
+    """Keep the newest `keep_last` COMPLETE bundles. Incomplete
+    (uncommitted) bundles never count toward the quota — and any
+    incomplete bundle older than the newest complete one is a stale
+    torn write from a killed run, removed as garbage. Every removal
+    tolerates a concurrent rank racing us to it."""
+    complete: List[int] = []
+    stale: List[int] = []
+    for name in _listdir(ckpt_dir):
         it = _bundle_iter(name)
-        if it is not None:
-            bundles.append(it)
-    for it in sorted(bundles)[:-keep_last]:
+        if it is None:
+            continue
+        if _is_complete(os.path.join(ckpt_dir, name)):
+            complete.append(it)
+        else:
+            stale.append(it)
+    complete.sort()
+    for it in complete[:-keep_last]:
         shutil.rmtree(os.path.join(ckpt_dir, _bundle_name(it)),
                       ignore_errors=True)
+    if complete:
+        newest = complete[-1]
+        for it in stale:
+            if it < newest:
+                shutil.rmtree(os.path.join(ckpt_dir, _bundle_name(it)),
+                              ignore_errors=True)
 
 
 def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
-    """Newest complete bundle under `ckpt_dir`, or None.
+    """Newest COMPLETE bundle under `ckpt_dir`, or None.
 
-    Trusts LATEST when it points at an existing bundle, otherwise scans
-    (LATEST is advisory; the bundles are the durable record)."""
+    Trusts LATEST when it points at an existing complete bundle,
+    otherwise scans (LATEST is advisory; the bundles are the durable
+    record). Coordinated bundles without their COMMIT marker — a rank
+    died between shard write and commit — are skipped."""
     if not os.path.isdir(ckpt_dir):
         return None
     latest = os.path.join(ckpt_dir, _LATEST)
@@ -139,14 +297,14 @@ def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
         with open(latest) as f:
             name = f.read().strip()
         cand = os.path.join(ckpt_dir, name)
-        if os.path.isfile(os.path.join(cand, "state.json")):
+        if _is_complete(cand):
             return cand
     best: Optional[int] = None
-    for name in os.listdir(ckpt_dir):
+    for name in _listdir(ckpt_dir):
         it = _bundle_iter(name)
         if it is None:
             continue
-        if not os.path.isfile(os.path.join(ckpt_dir, name, "state.json")):
+        if not _is_complete(os.path.join(ckpt_dir, name)):
             continue
         if best is None or it > best:
             best = it
@@ -154,26 +312,52 @@ def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
         else None
 
 
-def load_checkpoint(path: str) -> CheckpointState:
+def load_checkpoint(path: str, rank: Optional[int] = None,
+                    world: Optional[int] = None) -> CheckpointState:
     """Load a bundle. `path` may be a bundle directory or a checkpoint
-    directory (the newest complete bundle is picked)."""
+    directory (the newest complete bundle is picked).
+
+    Coordinated bundles require `rank` (to pick the shard arrays) and
+    validate the topology: a bundle written by W ranks only resumes
+    into a W-rank run — scores/bag masks are partition-local, and a
+    different partitioning would silently corrupt them."""
     bundle = path
-    if not os.path.isfile(os.path.join(bundle, "state.json")):
+    if not _is_complete(bundle):
         found = latest_checkpoint(path)
         if found is None:
-            raise LightGBMError(f"no checkpoint bundle found under {path!r}")
+            raise LightGBMError(
+                f"no complete checkpoint bundle found under {path!r}")
         bundle = found
-    with open(os.path.join(bundle, "state.json")) as f:
-        state = json.load(f)
+    state = _read_state(bundle)
+    if state is None:
+        raise LightGBMError(f"checkpoint {bundle!r} lost its state.json "
+                            f"mid-load (concurrent prune?)")
     ver = state.get("format_version")
     if ver != FORMAT_VERSION:
         raise LightGBMError(
             f"checkpoint {bundle!r} has format_version={ver!r}; "
             f"this build reads version {FORMAT_VERSION}")
+    ws = int(state.get("world_size", 1))
+    if ws > 1:
+        if rank is None:
+            raise LightGBMError(
+                f"checkpoint {bundle!r} was written by {ws} coordinated "
+                f"ranks; pass rank=/world= to pick this rank's shard")
+        if world is not None and int(world) != ws:
+            raise LightGBMError(
+                f"checkpoint {bundle!r} was written by world_size={ws} "
+                f"but this run has world_size={int(world)} — resume "
+                f"needs the same topology (partition-local state)")
+        if not 0 <= int(rank) < ws:
+            raise LightGBMError(
+                f"rank {rank} out of range for world_size={ws} "
+                f"checkpoint {bundle!r}")
+        npz_path = os.path.join(bundle, f"shard_{int(rank):03d}.npz")
+    else:
+        npz_path = os.path.join(bundle, "arrays.npz")
     with open(os.path.join(bundle, "model.txt")) as f:
         model_str = f.read()
     arrays: Dict[str, np.ndarray] = {}
-    npz_path = os.path.join(bundle, "arrays.npz")
     if os.path.isfile(npz_path):
         with np.load(npz_path) as npz:
             arrays = {k: npz[k] for k in npz.files}

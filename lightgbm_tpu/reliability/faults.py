@@ -28,17 +28,33 @@ site                        raised from
 All injection is host-side, at dispatch boundaries: raising inside
 jit/shard_map-traced code would either bake into the compiled program or
 never run, so the hooks sit where Python still owns control flow.
+
+Schedules fire in one of two modes. ``mode="raise"`` (default) raises
+`InjectedFault`, exercising the in-process recovery ladders.
+``mode="rank_death"`` instead terminates the whole process with
+``os._exit`` at the nth hit of the site — the chaos harness's model of
+a rank dying mid-collective (testing/chaos.py): no exception handler
+runs, no network goodbye is sent, peers are simply left waiting, which
+is exactly what the collective watchdog (reliability/watchdog.py) has
+to survive.
 """
 
 from __future__ import annotations
 
 import os
+import sys
 import threading
 from typing import Dict, Optional, Tuple
 
 __all__ = [
     "InjectedFault", "FaultRegistry", "faults", "KNOWN_SITES",
+    "RANK_DEATH_EXIT_CODE",
 ]
+
+#: exit status of a rank killed by a ``rank_death`` schedule —
+#: distinguishable from a watchdog abort (watchdog.WATCHDOG_EXIT_CODE)
+#: and from ordinary python failures (1) in chaos-test assertions
+RANK_DEATH_EXIT_CODE = 86
 
 KNOWN_SITES = (
     "fused_dispatch",
@@ -64,11 +80,12 @@ class InjectedFault(RuntimeError):
 
 
 class _Schedule:
-    __slots__ = ("skip", "fail")
+    __slots__ = ("skip", "fail", "mode")
 
-    def __init__(self, skip: int, fail: int):
+    def __init__(self, skip: int, fail: int, mode: str = "raise"):
         self.skip = int(skip)
         self.fail = int(fail)
+        self.mode = mode
 
 
 def parse_schedule(val: str) -> Tuple[int, int]:
@@ -77,6 +94,18 @@ def parse_schedule(val: str) -> Tuple[int, int]:
     if not fail:
         skip, fail = "0", skip
     return int(skip), int(fail)
+
+
+def _rank_death_exit(site: str) -> None:
+    """Kill this rank, abruptly. ``os._exit`` (not ``sys.exit``) is the
+    point: no exception propagation, no atexit hooks, no distributed
+    shutdown handshake — peers blocked in a collective get NO signal,
+    which is the failure the watchdog deadline exists to catch. Tests
+    stub this function to observe the firing without dying."""
+    print(f"lightgbm_tpu: injected rank_death at site '{site}' "
+          f"(os._exit({RANK_DEATH_EXIT_CODE}))", file=sys.stderr,
+          flush=True)
+    os._exit(RANK_DEATH_EXIT_CODE)
 
 
 class FaultRegistry:
@@ -97,19 +126,25 @@ class FaultRegistry:
         self._env_seen: Dict[Tuple[str, str], str] = {}
 
     # -- arming ---------------------------------------------------------
-    def schedule(self, site: str, fail: int = 1, skip: int = 0) -> None:
+    def schedule(self, site: str, fail: int = 1, skip: int = 0,
+                 mode: str = "raise") -> None:
+        if mode not in ("raise", "rank_death"):
+            raise ValueError(f"unknown fault mode {mode!r} "
+                             f"(expected 'raise' or 'rank_death')")
         with self._lock:
             if fail <= 0 and skip <= 0:
                 self._schedules.pop(site, None)
             else:
-                self._schedules[site] = _Schedule(skip, fail)
+                self._schedules[site] = _Schedule(skip, fail, mode)
 
     def schedule_from_env(self, site: str, env: str) -> None:
         """Seed `site`'s schedule from environment variable `env`.
 
         The env var is read-only state: the countdown lives in the
         registry, and re-seeding only happens when the raw env value
-        changes (so a consumed schedule stays consumed)."""
+        changes (so a consumed schedule stays consumed). A
+        ``:rank_death`` suffix ("S:N:rank_death") selects the
+        process-killing mode."""
         val = os.environ.get(env, "")
         with self._lock:
             key = (env, site)
@@ -119,8 +154,13 @@ class FaultRegistry:
             if not val:
                 self._schedules.pop(site, None)
                 return
-            skip, fail = parse_schedule(val)
-            self._schedules[site] = _Schedule(skip, fail)
+            mode = "raise"
+            sched_val = val
+            if val.endswith(":rank_death"):
+                mode = "rank_death"
+                sched_val = val[:-len(":rank_death")]
+            skip, fail = parse_schedule(sched_val)
+            self._schedules[site] = _Schedule(skip, fail, mode)
 
     def clear(self, site: Optional[str] = None) -> None:
         with self._lock:
@@ -138,7 +178,10 @@ class FaultRegistry:
 
     # -- firing ---------------------------------------------------------
     def inject(self, site: str) -> None:
-        """Consume one schedule step at `site`; raise when it fires."""
+        """Consume one schedule step at `site`. When it fires, either
+        raise `InjectedFault` (mode "raise") or terminate the process
+        (mode "rank_death") — the chosen action runs OUTSIDE the lock."""
+        mode = None
         with self._lock:
             self._calls[site] = self._calls.get(site, 0) + 1
             sched = self._schedules.get(site)
@@ -152,9 +195,13 @@ class FaultRegistry:
                 if sched.fail == 0 and sched.skip == 0:
                     del self._schedules[site]
                 self._trips[site] = self._trips.get(site, 0) + 1
+                mode = sched.mode
             else:
                 del self._schedules[site]
                 return
+        if mode == "rank_death":
+            _rank_death_exit(site)
+            return      # only reachable when _rank_death_exit is stubbed
         raise InjectedFault(site)
 
     # -- observation ----------------------------------------------------

@@ -1,0 +1,376 @@
+"""Collective watchdog: a dead rank must fail loudly, not hang forever.
+
+XLA collectives assume every participant eventually arrives. When a
+rank dies mid-run (OOM kill, preemption, the chaos harness's
+``rank_death`` injection), its peers block inside the next collective
+with no error, no timeout and no diagnostic — the failure mode the
+reference's socket layer (network.h:89-275) could at least surface as
+a recv() error. This module restores that property at the host
+boundary:
+
+- every host-side collective entry point (parallel/comm.py
+  ``guarded_allgather``, the GBDT sharded-growth dispatch) brackets the
+  blocking call in a `CollectiveGuard` deadline
+  (``collective_timeout_s``);
+- each rank writes a lightweight file heartbeat (``heartbeat_dir``,
+  shared filesystem) every ``heartbeat_interval_s``;
+- when a bracket overruns its deadline, a monitor thread reads the peer
+  heartbeats, diagnoses "rank k last seen Ns ago", logs it, and aborts
+  the local process with ``os._exit(WATCHDOG_EXIT_CODE)`` — hanging
+  forever is strictly worse than dying with a named culprit.
+
+The guard is OFF by default: it arms only when ``collective_timeout_s``
+is set > 0 AND more than one process participates, so single-host runs
+(and the entire tier-1 suite) never pay a thread or a branch. The first
+bracket of each site label gets ``FIRST_DEADLINE_FACTOR`` x the
+deadline, because the first dispatch of a sharded program includes its
+XLA compilation.
+
+Deadlines use the monotonic clock (process-local intervals); heartbeat
+files carry wall-clock stamps (cross-process ages). Both clocks are
+injectable for the fake-clock unit tests (tests/test_watchdog.py).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, Dict, Optional
+
+from ..utils.log import Log
+from .faults import InjectedFault
+
+__all__ = [
+    "CollectiveGuard", "WATCHDOG_EXIT_CODE", "FIRST_DEADLINE_FACTOR",
+    "active_guard", "collective_guard", "configure_watchdog",
+    "maybe_start_watchdog", "shutdown_watchdog",
+    "read_heartbeats", "write_heartbeat",
+]
+
+#: exit status of a watchdog abort — distinct from RANK_DEATH_EXIT_CODE
+#: (the injected death) and from ordinary failures (1), so chaos tests
+#: can tell the killed rank from the survivor that diagnosed it
+WATCHDOG_EXIT_CODE = 113
+
+#: first bracket of each site label stretches the deadline by this
+#: factor: the first sharded-growth dispatch includes XLA compilation,
+#: which legitimately dwarfs any steady-state collective
+FIRST_DEADLINE_FACTOR = 4.0
+
+_HB_PREFIX = "hb_rank_"
+
+
+# ----------------------------------------------------------------------
+# heartbeat files: tmp+replace so readers never see a torn stamp
+def write_heartbeat(heartbeat_dir: str, rank: int, now: float) -> None:
+    """Stamp `rank`'s liveness at wall-clock `now` (atomic replace)."""
+    os.makedirs(heartbeat_dir, exist_ok=True)
+    path = os.path.join(heartbeat_dir, f"{_HB_PREFIX}{rank:03d}")
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(repr(float(now)))
+    os.replace(tmp, path)
+
+
+def read_heartbeats(heartbeat_dir: str) -> Dict[int, float]:
+    """{rank: last wall-clock stamp} for every readable heartbeat file.
+    Tolerates concurrent writers and vanishing files (ENOENT races)."""
+    stamps: Dict[int, float] = {}
+    try:
+        names = os.listdir(heartbeat_dir)
+    except (FileNotFoundError, NotADirectoryError):
+        return stamps
+    for name in names:
+        if not name.startswith(_HB_PREFIX) or name.endswith(".tmp"):
+            continue
+        try:
+            rank = int(name[len(_HB_PREFIX):])
+            with open(os.path.join(heartbeat_dir, name)) as f:
+                stamps[rank] = float(f.read().strip())
+        except (ValueError, OSError):
+            continue        # torn tmp name / racing unlink: skip
+    return stamps
+
+
+class CollectiveGuard:
+    """Deadline + heartbeat bracket around blocking collectives.
+
+    Pure state machine over injectable clocks: `enter`/`exit_` mark the
+    active bracket, `poll` reports an overrun (as the diagnostic string)
+    without side effects, and `start` wires the real-time threads that
+    call them. Unit tests drive enter/poll with fake clocks and an
+    `abort_fn` stub; production uses the monitor thread and os._exit."""
+
+    def __init__(self, timeout_s: float, rank: int = 0, world: int = 1,
+                 heartbeat_dir: str = "",
+                 heartbeat_interval_s: float = 1.0,
+                 first_deadline_factor: float = FIRST_DEADLINE_FACTOR,
+                 clock: Callable[[], float] = time.monotonic,
+                 wall: Callable[[], float] = time.time,
+                 abort_fn: Optional[Callable[[str], None]] = None):
+        if timeout_s <= 0:
+            raise ValueError("CollectiveGuard needs collective_timeout_s"
+                             " > 0 (0 disables the watchdog)")
+        self.timeout_s = float(timeout_s)
+        self.rank = int(rank)
+        self.world = int(world)
+        self.heartbeat_dir = heartbeat_dir
+        self.interval_s = max(1e-3, float(heartbeat_interval_s))
+        self.first_factor = max(1.0, float(first_deadline_factor))
+        self._clock = clock
+        self._wall = wall
+        self._abort_fn = abort_fn
+        self._lock = threading.Lock()
+        self._site: Optional[str] = None
+        self._deadline: Optional[float] = None
+        self._entered: Optional[float] = None
+        self._seen_sites: set = set()
+        self._stop = threading.Event()
+        self._threads: list = []
+
+    # -- bracket --------------------------------------------------------
+    def enter(self, site: str) -> None:
+        factor = 1.0
+        with self._lock:
+            if site not in self._seen_sites:
+                self._seen_sites.add(site)
+                factor = self.first_factor
+            self._site = site
+            self._entered = self._clock()
+            self._deadline = self._entered + self.timeout_s * factor
+        self.heartbeat_once()
+
+    def exit_(self) -> None:
+        from ..observability.registry import registry
+        with self._lock:
+            entered, site = self._entered, self._site
+            self._site = self._deadline = self._entered = None
+        if entered is not None:
+            registry.record_collective_guard(self._clock() - entered)
+
+    @contextmanager
+    def guard(self, site: str):
+        """Bracket one blocking collective. An exception inside the
+        bracket (a peer connection dropping often surfaces as a
+        dispatch error rather than a hang) gets the same heartbeat
+        diagnosis logged before it propagates; `InjectedFault` is the
+        in-process test hook and passes through silently."""
+        self.enter(site)
+        try:
+            yield
+        except InjectedFault:
+            raise
+        except BaseException:
+            diag = self.diagnose(site)
+            Log.warning("collective watchdog: error inside collective "
+                        "bracket — %s", diag)
+            print(f"collective watchdog: {diag}", file=sys.stderr,
+                  flush=True)
+            raise
+        finally:
+            self.exit_()
+
+    # -- liveness -------------------------------------------------------
+    def heartbeat_once(self) -> None:
+        if self.heartbeat_dir:
+            try:
+                write_heartbeat(self.heartbeat_dir, self.rank,
+                                self._wall())
+            except OSError as exc:
+                Log.warning("collective watchdog: heartbeat write "
+                            "failed (%s: %s)", type(exc).__name__, exc)
+
+    def heartbeat_ages(self) -> Dict[int, float]:
+        """{rank: seconds since last stamp} for every rank with a
+        heartbeat file (missing ranks simply have no entry)."""
+        now = self._wall()
+        return {r: max(0.0, now - ts) for r, ts in
+                read_heartbeats(self.heartbeat_dir).items()} \
+            if self.heartbeat_dir else {}
+
+    def diagnose(self, site: str) -> str:
+        """Human-readable account of who went quiet, built from the
+        heartbeat files — 'rank k last seen Ns ago' names the culprit."""
+        head = (f"collective '{site}' exceeded collective_timeout_s="
+                f"{self.timeout_s:g} on rank {self.rank}")
+        if not self.heartbeat_dir:
+            return head + " (no heartbeat_dir configured; cannot name " \
+                          "the stalled rank)"
+        ages = self.heartbeat_ages()
+        from ..observability.registry import registry
+        peers = {r: a for r, a in ages.items() if r != self.rank}
+        if peers:
+            registry.record_heartbeat_age(max(peers.values()))
+        stale_after = 3.0 * self.interval_s
+        missing = [r for r in range(self.world)
+                   if r != self.rank and r not in ages]
+        stale = sorted((a, r) for r, a in peers.items()
+                       if a > stale_after)
+        parts = []
+        for age, r in reversed(stale):
+            parts.append(f"rank {r} last seen {age:.1f}s ago")
+        for r in missing:
+            parts.append(f"rank {r} never heartbeat")
+        if not parts:
+            return head + (" — all peer heartbeats fresh (wedged "
+                           "interconnect, or this rank is the straggler)")
+        return head + ": " + ", ".join(parts)
+
+    # -- monitoring -----------------------------------------------------
+    def poll(self) -> Optional[str]:
+        """Diagnostic string if the active bracket overran its
+        deadline, else None. Side-effect free; callable from tests."""
+        with self._lock:
+            expired = (self._deadline is not None and
+                       self._clock() > self._deadline)
+            site = self._site
+        if not expired or site is None:
+            return None
+        from ..observability.registry import registry
+        registry.record_collective_timeout()
+        return self.diagnose(site)
+
+    def _abort(self, diag: str) -> None:
+        from ..observability.registry import registry
+        registry.record_collective_abort()
+        msg = ("collective watchdog: " + diag +
+               f" — aborting this rank (os._exit({WATCHDOG_EXIT_CODE})) "
+               f"instead of hanging; resume from the last coordinated "
+               f"checkpoint")
+        Log.warning(msg)
+        print(msg, file=sys.stderr, flush=True)
+        if self._abort_fn is not None:
+            self._abort_fn(diag)
+            return
+        os._exit(WATCHDOG_EXIT_CODE)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.heartbeat_once()
+
+    def _monitor_loop(self) -> None:
+        poll_s = max(0.05, min(0.5, self.interval_s, self.timeout_s / 8))
+        while not self._stop.wait(poll_s):
+            diag = self.poll()
+            if diag is not None:
+                self._abort(diag)
+                return      # only reached with a stubbed abort_fn
+
+    def start(self) -> "CollectiveGuard":
+        self.heartbeat_once()
+        threads = []
+        for target, name in ((self._heartbeat_loop, "lgbm-heartbeat"),
+                             (self._monitor_loop, "lgbm-watchdog")):
+            th = threading.Thread(target=target, name=name, daemon=True)
+            th.start()
+            threads.append(th)
+        with self._lock:
+            self._threads = threads
+        Log.info("collective watchdog armed: rank %d/%d, "
+                 "collective_timeout_s=%g, heartbeat_dir=%r",
+                 self.rank, self.world, self.timeout_s,
+                 self.heartbeat_dir or "<none>")
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:               # joins happen lockless: the
+            threads = self._threads    # monitor's poll() needs the lock
+            self._threads = []
+        for th in threads:
+            th.join(timeout=2.0)
+
+
+# ----------------------------------------------------------------------
+# process-global guard: configured once per multihost run, consulted by
+# every collective entry point through `collective_guard(...)`
+_guard: Optional[CollectiveGuard] = None
+_guard_lock = threading.Lock()
+
+
+def active_guard() -> Optional[CollectiveGuard]:
+    return _guard
+
+
+@contextmanager
+def collective_guard(site: str):
+    """Bracket a blocking collective with the configured guard; a
+    no-op (no branch beyond one global read) when the watchdog is
+    disabled — the single-host/tier-1 fast path."""
+    g = _guard
+    if g is None:
+        yield
+        return
+    with g.guard(site):
+        yield
+
+
+def configure_watchdog(timeout_s: float, rank: int = 0, world: int = 1,
+                       heartbeat_dir: str = "",
+                       interval_s: float = 1.0,
+                       abort_fn: Optional[Callable[[str], None]] = None
+                       ) -> Optional[CollectiveGuard]:
+    """Install (or tear down) the process-global guard. Disabled — and
+    any previous guard stopped — when `timeout_s` <= 0 or `world` <= 1:
+    the watchdog is strictly a multi-process affair. Idempotent for
+    unchanged settings, so every collective entry point may call it."""
+    global _guard
+    with _guard_lock:
+        if timeout_s <= 0 or world <= 1:
+            if _guard is not None:
+                _guard.stop()
+                _guard = None
+            return None
+        g = _guard
+        if (g is not None and g.timeout_s == float(timeout_s) and
+                g.rank == int(rank) and g.world == int(world) and
+                g.heartbeat_dir == heartbeat_dir and
+                g.interval_s == float(interval_s)):
+            return g
+        if g is not None:
+            g.stop()
+        from ..observability.registry import registry
+        registry.record_collective_world(int(world))
+        _guard = CollectiveGuard(
+            timeout_s, rank=rank, world=world,
+            heartbeat_dir=heartbeat_dir,
+            heartbeat_interval_s=interval_s, abort_fn=abort_fn).start()
+        return _guard
+
+
+def maybe_start_watchdog(cfg) -> Optional[CollectiveGuard]:
+    """Arm the watchdog from a resolved `Config` if this really is a
+    multi-process run. Called from the collective entry points
+    themselves (distributed bin finding, `_setup_parallel`), so
+    whichever runs first arms it; cheap and idempotent afterwards.
+    With no explicit `heartbeat_dir` the heartbeats ride under
+    `checkpoint_dir` — already required to be a shared filesystem for
+    coordinated checkpoints."""
+    timeout_s = float(getattr(cfg, "collective_timeout_s", 0.0) or 0.0)
+    if timeout_s <= 0:
+        return None
+    import jax
+    try:
+        world = jax.process_count()
+    except RuntimeError:
+        world = 1
+    if world <= 1:
+        return None
+    hb = cfg.heartbeat_dir
+    if not hb and cfg.checkpoint_dir:
+        hb = os.path.join(cfg.checkpoint_dir, "heartbeats")
+    return configure_watchdog(timeout_s, rank=jax.process_index(),
+                              world=world, heartbeat_dir=hb,
+                              interval_s=cfg.heartbeat_interval_s)
+
+
+def shutdown_watchdog() -> None:
+    """Stop the global guard and its threads (tests; end of run)."""
+    global _guard
+    with _guard_lock:
+        if _guard is not None:
+            _guard.stop()
+            _guard = None

@@ -1,1 +1,9 @@
-"""Test-support utilities (no runtime dependencies on the main API)."""
+"""Test-support utilities (no runtime dependencies on the main API).
+
+- `subproc`: hang-safe multi-rank subprocess launcher (shared deadline,
+  leaked children always killed) — the one spawn path for every
+  two-process test and the chaos harness.
+- `chaos`: rank-death chaos harness (kill one rank mid-collective,
+  diagnose, resume) — docs/Reliability.md "Distributed fault model".
+- `dask_stub`: minimal dask-like cluster stand-in for dask.py tests.
+"""

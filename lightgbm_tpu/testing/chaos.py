@@ -1,0 +1,148 @@
+"""Rank-death chaos harness for distributed survivability tests.
+
+The scenario tests/test_chaos.py drives (docs/Reliability.md,
+"Distributed fault model"):
+
+1. a reference 2-rank run trains to completion, checkpointing on a
+   period, and saves its model — the ground truth;
+2. a chaos run arms ``faults.schedule("collective_psum",
+   mode="rank_death")`` on ONE rank at a chosen iteration: that rank
+   `os._exit`s mid-collective with no goodbye, and the survivor must
+   abort within ~2x `collective_timeout_s` carrying a "rank k last
+   seen Ns ago" diagnostic instead of hanging forever;
+3. both ranks relaunch with ``resume_from`` pointed at the chaos run's
+   checkpoint directory; the last COORDINATED bundle (COMMIT marker
+   present) restores, and the finished model must be byte-identical to
+   the reference — proving the watchdog + coordinated-checkpoint +
+   resume pipeline loses nothing but wall-clock.
+
+The worker below is self-contained source (no pytest imports inside
+the subprocess) parameterized entirely through TEST_* env vars, built
+on the same spawn pattern as tests/test_multihost.py via
+`testing.subproc.run_ranks`.
+"""
+
+from __future__ import annotations
+
+import os
+import textwrap
+from typing import Dict, List, Optional
+
+from .subproc import RankResult, free_port, rank_env, run_ranks
+
+__all__ = ["CHAOS_WORKER", "run_chaos_training",
+           "strip_rank_local_params"]
+
+#: worker source for one rank of a (possibly chaos-injected) 2-rank
+#: training run. Env contract — TEST_PORTS, TEST_OUT, TEST_ROUNDS,
+#: TEST_CKPT_DIR/TEST_CKPT_PERIOD (checkpointing), TEST_TIMEOUT_S
+#: (collective watchdog; "0" disables), TEST_DEATH_RANK/TEST_DEATH_ITER
+#: (rank_death arming; death rank < 0 disables), TEST_RESUME ("1" to
+#: resume from TEST_CKPT_DIR).
+CHAOS_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, os.environ["TEST_REPO"])
+    rank = int(os.environ["LIGHTGBM_TPU_MACHINE_RANK"])
+    ports = os.environ["TEST_PORTS"].split(",")
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.reliability import faults
+    lgb.setup_multihost(
+        2, ",".join(f"127.0.0.1:{p}" for p in ports),
+        local_listen_port=int(ports[rank]))
+
+    def make_data(n=4096, f=8, seed=7):
+        r = np.random.RandomState(seed)
+        X = r.randn(n, f)
+        logit = X[:, 0] * 1.5 + 0.5 * X[:, 1] ** 2 - X[:, 2] + \\
+            0.3 * r.randn(n)
+        y = (logit > np.median(logit)).astype(np.float32)
+        return X, y
+
+    X, y = make_data()
+    cut = len(y) // 2
+    sl = slice(0, cut) if rank == 0 else slice(cut, None)
+    ckpt_dir = os.environ["TEST_CKPT_DIR"]
+    params = dict(objective="binary", tree_learner="data",
+                  num_machines=2,
+                  machines=",".join(f"127.0.0.1:{p}" for p in ports),
+                  local_listen_port=int(ports[rank]),
+                  num_leaves=15, verbosity=-1, min_data_in_leaf=20,
+                  enable_bundle=False, boost_from_average=False,
+                  checkpoint_period=int(os.environ["TEST_CKPT_PERIOD"]),
+                  checkpoint_dir=ckpt_dir,
+                  collective_timeout_s=float(os.environ["TEST_TIMEOUT_S"]),
+                  heartbeat_interval_s=0.25,
+                  heartbeat_dir=os.path.join(ckpt_dir, "heartbeats"))
+
+    death_rank = int(os.environ.get("TEST_DEATH_RANK", "-1"))
+    death_iter = int(os.environ.get("TEST_DEATH_ITER", "-1"))
+    callbacks = []
+    if death_rank == rank and death_iter >= 0:
+        def _arm(env):
+            # arm at the START of the target iteration, so this rank
+            # dies inside that iteration's first host collective while
+            # its peer has already committed to the same collective
+            if env.iteration == death_iter:
+                faults.schedule("collective_psum", fail=1,
+                                mode="rank_death")
+        _arm.before_iteration = True
+        _arm.order = 0
+        callbacks.append(_arm)
+
+    resume = os.environ.get("TEST_RESUME", "0") == "1"
+    bst = lgb.train(params,
+                    lgb.Dataset(X[sl], label=y[sl]),
+                    int(os.environ["TEST_ROUNDS"]),
+                    callbacks=callbacks,
+                    resume_from=ckpt_dir if resume else None)
+    bst.save_model(os.environ["TEST_OUT"])
+    print("CHAOS_WORKER_DONE rank", rank)
+""")
+
+
+def run_chaos_training(workdir: str, *, rounds: int,
+                       ckpt_period: int, ckpt_dir: str,
+                       timeout_s: float, death_rank: int = -1,
+                       death_iter: int = -1, resume: bool = False,
+                       harness_timeout: float = 420.0,
+                       out_prefix: str = "model") -> List[RankResult]:
+    """Launch the 2-rank chaos worker; returns per-rank results. Model
+    files land at ``<workdir>/<out_prefix>_<rank>.txt``."""
+    from .subproc import repo_root
+    os.makedirs(workdir, exist_ok=True)
+    worker_py = os.path.join(workdir, "chaos_worker.py")
+    with open(worker_py, "w") as f:
+        f.write(CHAOS_WORKER)
+    ports = [str(free_port()), str(free_port())]
+    envs: List[Dict[str, str]] = []
+    import sys
+    argvs = []
+    for rank in range(2):
+        envs.append(rank_env(
+            rank,
+            TEST_REPO=repo_root(),
+            TEST_PORTS=",".join(ports),
+            TEST_OUT=os.path.join(workdir, f"{out_prefix}_{rank}.txt"),
+            TEST_ROUNDS=rounds,
+            TEST_CKPT_DIR=ckpt_dir,
+            TEST_CKPT_PERIOD=ckpt_period,
+            TEST_TIMEOUT_S=timeout_s,
+            TEST_DEATH_RANK=death_rank,
+            TEST_DEATH_ITER=death_iter,
+            TEST_RESUME="1" if resume else "0"))
+        argvs.append([sys.executable, worker_py])
+    return run_ranks(argvs, envs=envs, cwd=workdir,
+                     timeout=harness_timeout)
+
+
+def strip_rank_local_params(model_text: str) -> str:
+    """Drop the dumped-parameter lines that legitimately differ between
+    ranks and runs (each rank records its own listen port; checkpoint
+    and heartbeat paths differ per tmp dir) so model byte-parity
+    compares the trees and learned state, nothing else."""
+    drop = ("local_listen_port", "machines", "checkpoint_dir",
+            "heartbeat_dir", "checkpoint_period", "collective_timeout",
+            "heartbeat_interval")
+    return "\n".join(ln for ln in model_text.splitlines()
+                     if not any(key in ln for key in drop))

@@ -1,0 +1,97 @@
+"""Rank-death chaos test: the ISSUE acceptance scenario end-to-end.
+
+A 2-rank CPU-backed multihost run loses rank 1 to an injected
+`rank_death` (`os._exit`, no goodbye) inside iteration 5's first host
+collective. The survivor must NOT hang: the collective watchdog
+deadline turns the silent peer into a "rank 1 last seen Ns ago"
+diagnostic and a prompt abort. Relaunching both ranks with
+`resume_from` restores the last COMMIT-marked coordinated bundle and
+finishes to a model byte-identical to an unkilled reference run.
+
+Slow (three 2-process training runs + one watchdog deadline wait):
+excluded from tier-1 via the `slow` marker; run with `make chaos`.
+"""
+
+import os
+
+import pytest
+
+from lightgbm_tpu.reliability.checkpoint import (COMMIT_MARKER,
+                                                 latest_checkpoint)
+from lightgbm_tpu.reliability.faults import RANK_DEATH_EXIT_CODE
+from lightgbm_tpu.testing.chaos import (run_chaos_training,
+                                        strip_rank_local_params)
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+ROUNDS = 8
+CKPT_PERIOD = 2
+TIMEOUT_S = 30.0        # steady-state deadline; first bracket gets 4x
+DEATH_ITER = 5          # last coordinated commit lands at iteration 4
+
+
+def _read_model(workdir, rank):
+    with open(os.path.join(workdir, f"model_{rank}.txt")) as f:
+        return strip_rank_local_params(f.read())
+
+
+def _assert_clean(results, what):
+    for r in results:
+        assert not r.timed_out, f"{what} rank {r.rank} hung:\n{r.tail()}"
+        assert r.returncode == 0, \
+            f"{what} rank {r.rank} rc={r.returncode}:\n{r.tail()}"
+        assert "CHAOS_WORKER_DONE" in r.output
+
+
+def test_rank_death_survivor_aborts_and_resume_is_byte_identical(
+        tmp_path):
+    # ---- 1. unkilled reference run: the ground-truth model ----------
+    ref_dir = str(tmp_path / "ref")
+    ref = run_chaos_training(
+        ref_dir, rounds=ROUNDS, ckpt_period=CKPT_PERIOD,
+        ckpt_dir=os.path.join(ref_dir, "ckpts"), timeout_s=TIMEOUT_S)
+    _assert_clean(ref, "reference")
+    ref_model = _read_model(ref_dir, 0)
+    assert ref_model == _read_model(ref_dir, 1)   # SPMD: same model
+
+    # ---- 2. chaos run: rank 1 dies inside iteration 5's collective --
+    chaos_dir = str(tmp_path / "chaos")
+    chaos_ckpts = os.path.join(chaos_dir, "ckpts")
+    res = {r.rank: r for r in run_chaos_training(
+        chaos_dir, rounds=ROUNDS, ckpt_period=CKPT_PERIOD,
+        ckpt_dir=chaos_ckpts, timeout_s=TIMEOUT_S,
+        death_rank=1, death_iter=DEATH_ITER)}
+
+    dead, survivor = res[1], res[0]
+    assert not dead.timed_out and not survivor.timed_out, (
+        f"chaos run hung:\nrank0:\n{survivor.tail()}\n"
+        f"rank1:\n{dead.tail()}")
+    assert dead.returncode == RANK_DEATH_EXIT_CODE, dead.tail()
+    assert "rank_death" in dead.output
+    # the survivor must fail loudly — non-zero, with the watchdog's
+    # named-culprit diagnostic — not hang and not "succeed"
+    assert survivor.returncode not in (0, RANK_DEATH_EXIT_CODE), \
+        survivor.tail()
+    assert "rank 1 last seen" in survivor.output, survivor.tail()
+    # ... and promptly: within 2x the steady-state deadline of the
+    # moment its peer died (the rank-death exit timestamps that moment)
+    assert survivor.duration_s - dead.duration_s <= 2 * TIMEOUT_S, (
+        f"survivor outlived its peer by "
+        f"{survivor.duration_s - dead.duration_s:.1f}s "
+        f"(> 2x collective_timeout_s={TIMEOUT_S:g})")
+
+    # ---- 3. the aftermath: last COMMITTED bundle is iteration 4 -----
+    latest = latest_checkpoint(chaos_ckpts)
+    assert latest is not None and latest.endswith("ckpt_0000004")
+    assert os.path.isfile(os.path.join(latest, COMMIT_MARKER))
+
+    # ---- 4. resume both ranks from the chaos checkpoints ------------
+    resume_dir = str(tmp_path / "resume")
+    resumed = run_chaos_training(
+        resume_dir, rounds=ROUNDS, ckpt_period=CKPT_PERIOD,
+        ckpt_dir=chaos_ckpts, timeout_s=TIMEOUT_S, resume=True)
+    _assert_clean(resumed, "resume")
+    # byte-parity with the unkilled run: the kill + watchdog abort +
+    # coordinated-checkpoint resume lost nothing but wall-clock
+    assert _read_model(resume_dir, 0) == ref_model
+    assert _read_model(resume_dir, 1) == ref_model

@@ -4,16 +4,25 @@ sockets; here N python processes joined by jax.distributed, each holding
 its row partition, with histogram psums spanning both)."""
 
 import os
-import socket
-import subprocess
 import sys
-import tempfile
 import textwrap
 
 import numpy as np
 import pytest
 
+from lightgbm_tpu.testing.subproc import (free_port, rank_env,
+                                          run_ranks)
+
 pytestmark = pytest.mark.slow  # spawns processes, compiles twice
+
+
+def _assert_all_ok(results, what):
+    """Shared post-mortem for a 2-rank launch: fail loudly on timeout
+    (children already killed by run_ranks) or non-zero exit."""
+    if any(r.timed_out for r in results):
+        pytest.fail(f"{what} timed out")
+    for r in results:
+        assert r.returncode == 0, f"rank {r.rank}: {r.tail()}"
 
 _WORKER = textwrap.dedent("""
     import os, sys
@@ -53,14 +62,6 @@ _DATA_MOD = textwrap.dedent("""
 """)
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
 @pytest.mark.parametrize("uneven", [0, 17])
 def test_two_process_matches_single_process(tmp_path, uneven):
     _run_two_process(tmp_path, uneven, "binary", exact=True)
@@ -78,37 +79,20 @@ def _run_two_process(tmp_path, uneven, objective, exact):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     (tmp_path / "conftest_data.py").write_text(_DATA_MOD)
     (tmp_path / "worker.py").write_text(_WORKER.format(repo=repo))
-    ports = [str(_free_port()), str(_free_port())]
-    procs = []
-    outs = []
-    for rank in range(2):
-        out = tmp_path / f"model_{rank}.txt"
-        outs.append(out)
-        env = dict(os.environ,
-                   JAX_PLATFORMS="cpu",
-                   XLA_FLAGS="--xla_force_host_platform_device_count=4",
-                   LIGHTGBM_TPU_MACHINE_RANK=str(rank),
-                   TEST_PORTS=",".join(ports),
-                   TEST_OUT=str(out),
-                   TEST_UNEVEN=str(uneven),
-                   TEST_OBJECTIVE=objective,
-                   PYTHONPATH=str(tmp_path))
-        # a site hook in some environments initializes the JAX backend at
-        # interpreter start, which forbids jax.distributed.initialize;
-        # drop its trigger so workers start with an untouched backend
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        procs.append(subprocess.Popen(
-            [sys.executable, str(tmp_path / "worker.py")], env=env,
-            cwd=str(tmp_path), stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT))
-    for p in procs:
-        try:
-            out_text, _ = p.communicate(timeout=420)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("multi-process training timed out")
-        assert p.returncode == 0, out_text.decode()[-3000:]
+    ports = [str(free_port()), str(free_port())]
+    outs = [tmp_path / f"model_{rank}.txt" for rank in range(2)]
+    results = run_ranks(
+        [[sys.executable, str(tmp_path / "worker.py")]
+         for _ in range(2)],
+        envs=[rank_env(rank,
+                       TEST_PORTS=",".join(ports),
+                       TEST_OUT=str(outs[rank]),
+                       TEST_UNEVEN=str(uneven),
+                       TEST_OBJECTIVE=objective,
+                       PYTHONPATH=str(tmp_path))
+              for rank in range(2)],
+        cwd=str(tmp_path))
+    _assert_all_ok(results, "multi-process training")
 
     # both ranks hold the identical replicated model (the dumped
     # parameters section records each rank's own listen port — the only
@@ -162,37 +146,22 @@ def test_cli_shared_file_two_process(tmp_path):
     y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
     np.savetxt(tmp_path / "train.csv",
                np.column_stack([y, X]), delimiter=",", fmt="%.7f")
-    ports = [str(_free_port()), str(_free_port())]
+    ports = [str(free_port()), str(free_port())]
     machines = ",".join(f"127.0.0.1:{p}" for p in ports)
-    procs, outs = [], []
-    for rank in range(2):
-        out = tmp_path / f"cli_model_{rank}.txt"
-        outs.append(out)
-        env = dict(os.environ,
-                   JAX_PLATFORMS="cpu",
-                   XLA_FLAGS="--xla_force_host_platform_device_count=4",
-                   LIGHTGBM_TPU_MACHINE_RANK=str(rank),
-                   PYTHONPATH=repo)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "lightgbm_tpu.cli",
-             "task=train", f"data={tmp_path / 'train.csv'}",
-             "label_column=0", "objective=binary", "num_iterations=5",
-             "num_leaves=15", "min_data_in_leaf=20", "verbosity=-1",
-             "boost_from_average=false", "tree_learner=data",
-             "num_machines=2", f"machines={machines}",
-             f"local_listen_port={ports[rank]}",
-             f"output_model={out}"],
-            env=env, cwd=str(tmp_path), stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT))
-    for p in procs:
-        try:
-            out_text, _ = p.communicate(timeout=420)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("CLI multi-process training timed out")
-        assert p.returncode == 0, out_text.decode()[-3000:]
+    outs = [tmp_path / f"cli_model_{rank}.txt" for rank in range(2)]
+    results = run_ranks(
+        [[sys.executable, "-m", "lightgbm_tpu.cli",
+          "task=train", f"data={tmp_path / 'train.csv'}",
+          "label_column=0", "objective=binary", "num_iterations=5",
+          "num_leaves=15", "min_data_in_leaf=20", "verbosity=-1",
+          "boost_from_average=false", "tree_learner=data",
+          "num_machines=2", f"machines={machines}",
+          f"local_listen_port={ports[rank]}",
+          f"output_model={outs[rank]}"]
+         for rank in range(2)],
+        envs=[rank_env(rank, PYTHONPATH=repo) for rank in range(2)],
+        cwd=str(tmp_path))
+    _assert_all_ok(results, "CLI multi-process training")
 
     import lightgbm_tpu as lgb
     m0 = lgb.Booster(model_file=str(outs[0]))
@@ -254,32 +223,20 @@ def test_two_process_sequence_input_matches_array_input(tmp_path):
     (tmp_path / "worker.py").write_text(_WORKER_SEQ.format(repo=repo))
     models = {}
     for mode in ("array", "seq"):
-        ports = [str(_free_port()), str(_free_port())]
-        procs, outs = [], []
-        for rank in range(2):
-            out = tmp_path / f"model_{mode}_{rank}.txt"
-            outs.append(out)
-            env = dict(os.environ,
-                       JAX_PLATFORMS="cpu",
-                       XLA_FLAGS="--xla_force_host_platform_device_count=4",
-                       LIGHTGBM_TPU_MACHINE_RANK=str(rank),
-                       TEST_PORTS=",".join(ports),
-                       TEST_OUT=str(out),
-                       TEST_INPUT=mode,
-                       PYTHONPATH=str(tmp_path))
-            env.pop("PALLAS_AXON_POOL_IPS", None)
-            procs.append(subprocess.Popen(
-                [sys.executable, str(tmp_path / "worker.py")], env=env,
-                cwd=str(tmp_path), stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT))
-        for p in procs:
-            try:
-                out_text, _ = p.communicate(timeout=420)
-            except subprocess.TimeoutExpired:
-                for q in procs:
-                    q.kill()
-                pytest.fail("multi-process training timed out")
-            assert p.returncode == 0, out_text.decode()[-3000:]
+        ports = [str(free_port()), str(free_port())]
+        outs = [tmp_path / f"model_{mode}_{rank}.txt"
+                for rank in range(2)]
+        results = run_ranks(
+            [[sys.executable, str(tmp_path / "worker.py")]
+             for _ in range(2)],
+            envs=[rank_env(rank,
+                           TEST_PORTS=",".join(ports),
+                           TEST_OUT=str(outs[rank]),
+                           TEST_INPUT=mode,
+                           PYTHONPATH=str(tmp_path))
+                  for rank in range(2)],
+            cwd=str(tmp_path))
+        _assert_all_ok(results, f"multi-process {mode} training")
         models[mode] = "\n".join(
             ln for ln in outs[0].read_text().splitlines()
             if "local_listen_port" not in ln and "machines" not in ln)
@@ -343,31 +300,18 @@ def test_two_process_efb_matches_single(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     (tmp_path / "conftest_data.py").write_text(_DATA_MOD + _SPARSE_DATA)
     (tmp_path / "worker.py").write_text(_WORKER_EFB.format(repo=repo))
-    ports = [str(_free_port()), str(_free_port())]
-    procs, outs = [], []
-    for rank in range(2):
-        out = tmp_path / f"model_{rank}.txt"
-        outs.append(out)
-        env = dict(os.environ,
-                   JAX_PLATFORMS="cpu",
-                   XLA_FLAGS="--xla_force_host_platform_device_count=4",
-                   LIGHTGBM_TPU_MACHINE_RANK=str(rank),
-                   TEST_PORTS=",".join(ports),
-                   TEST_OUT=str(out),
-                   PYTHONPATH=str(tmp_path))
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        procs.append(subprocess.Popen(
-            [sys.executable, str(tmp_path / "worker.py")], env=env,
-            cwd=str(tmp_path), stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT))
-    for p in procs:
-        try:
-            out_text, _ = p.communicate(timeout=420)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("multi-process EFB training timed out")
-        assert p.returncode == 0, out_text.decode()[-3000:]
+    ports = [str(free_port()), str(free_port())]
+    outs = [tmp_path / f"model_{rank}.txt" for rank in range(2)]
+    results = run_ranks(
+        [[sys.executable, str(tmp_path / "worker.py")]
+         for _ in range(2)],
+        envs=[rank_env(rank,
+                       TEST_PORTS=",".join(ports),
+                       TEST_OUT=str(outs[rank]),
+                       PYTHONPATH=str(tmp_path))
+              for rank in range(2)],
+        cwd=str(tmp_path))
+    _assert_all_ok(results, "multi-process EFB training")
 
     def strip_port(text):
         return "\n".join(ln for ln in text.splitlines()
@@ -404,17 +348,23 @@ def test_collective_manifest_entry_points_resolve():
     training: _allgather_find_mappers / _distributed_bin_mappers /
     _streaming_mapper_sync (distributed bin finding), and the GBDT
     sync points _setup_train, _setup_parallel, _sync_renewed_leaves,
-    _boost_from_average."""
+    _boost_from_average; guarded_allgather is the watchdog-bracketed
+    choke point they all funnel through, and checkpoint_agree the
+    one-int agreement the coordinated checkpoint protocol rides."""
     from lightgbm_tpu.analysis.rules_spmd import COLLECTIVE_MANIFEST
     from lightgbm_tpu.reliability.faults import KNOWN_SITES
     import lightgbm_tpu.basic as basic
     from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.parallel.comm import (checkpoint_agree,
+                                            guarded_allgather)
     from lightgbm_tpu.streaming.loader import build_streamed_dataset
     from lightgbm_tpu.learner.grower import grow_tree
     from lightgbm_tpu.learner.grower_mxu import grow_tree_mxu
     from lightgbm_tpu.learner.histogram_mxu import quantize_gradients
 
     resolvable = {
+        "guarded_allgather": guarded_allgather,
+        "checkpoint_agree": checkpoint_agree,
         "_allgather_find_mappers": basic._allgather_find_mappers,
         "_distributed_bin_mappers": basic._distributed_bin_mappers,
         "_streaming_mapper_sync": basic._streaming_mapper_sync,

@@ -1,0 +1,287 @@
+"""Collective-watchdog unit tests — tier-1, subprocess-free.
+
+The guard is a state machine over injectable clocks, so deadline
+expiry, compile grace, and heartbeat diagnosis are all testable with
+fake time; the one real-thread test stubs the abort so nothing calls
+`os._exit`. The end-to-end path (a real rank dying mid-collective) is
+the chaos harness's job (tests/test_chaos.py, `make chaos`)."""
+
+import importlib
+import threading
+
+import numpy as np
+import pytest
+
+# `reliability.__init__` re-exports the `faults` *registry*, which
+# shadows the submodule on attribute lookup — go through importlib to
+# get the module object the monkeypatched hook lives in
+faults_mod = importlib.import_module("lightgbm_tpu.reliability.faults")
+from lightgbm_tpu.config import param_dict_to_config
+from lightgbm_tpu.observability.registry import registry
+from lightgbm_tpu.parallel.comm import (checkpoint_agree,
+                                        checkpoint_coordinator,
+                                        guarded_allgather)
+from lightgbm_tpu.reliability.faults import (InjectedFault,
+                                             RANK_DEATH_EXIT_CODE,
+                                             faults)
+from lightgbm_tpu.reliability.watchdog import (CollectiveGuard,
+                                               FIRST_DEADLINE_FACTOR,
+                                               WATCHDOG_EXIT_CODE,
+                                               active_guard,
+                                               collective_guard,
+                                               configure_watchdog,
+                                               maybe_start_watchdog,
+                                               read_heartbeats,
+                                               shutdown_watchdog,
+                                               write_heartbeat)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.clear()
+    yield
+    faults.clear()
+    shutdown_watchdog()
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# deadline state machine (fake monotonic clock)
+
+def test_deadline_expiry_fake_clock():
+    clk = FakeClock()
+    g = CollectiveGuard(10.0, rank=0, world=2, clock=clk)
+    assert g.poll() is None          # no active bracket, nothing to say
+    g.enter("gather")
+    clk.advance(11.0)
+    # first bracket of a site carries the compile grace: 4x deadline
+    assert g.poll() is None
+    clk.advance(10.0 * FIRST_DEADLINE_FACTOR)
+    diag = g.poll()
+    assert diag is not None
+    assert "gather" in diag and "collective_timeout_s" in diag
+    g.exit_()
+    assert g.poll() is None          # bracket closed: deadline cleared
+    # second bracket of the SAME site: steady-state deadline, no grace
+    g.enter("gather")
+    clk.advance(11.0)
+    assert g.poll() is not None
+    g.exit_()
+
+
+def test_poll_fresh_bracket_is_quiet():
+    clk = FakeClock()
+    g = CollectiveGuard(10.0, clock=clk, world=2)
+    g.enter("x")
+    clk.advance(5.0)
+    assert g.poll() is None
+    g.exit_()
+
+
+# ---------------------------------------------------------------------------
+# heartbeat files + diagnosis
+
+def test_heartbeat_roundtrip_and_missing_dir(tmp_path):
+    hb = str(tmp_path / "hb")
+    write_heartbeat(hb, 0, 123.5)
+    write_heartbeat(hb, 1, 99.0)
+    assert read_heartbeats(hb) == {0: 123.5, 1: 99.0}
+    assert read_heartbeats(str(tmp_path / "nope")) == {}
+
+
+def test_stale_heartbeat_diagnosis_names_right_rank(tmp_path):
+    hb = str(tmp_path / "hb")
+    wall = FakeClock(500.0)
+    g = CollectiveGuard(10.0, rank=0, world=3, heartbeat_dir=hb,
+                        heartbeat_interval_s=1.0, wall=wall)
+    write_heartbeat(hb, 0, 500.0)    # self: fresh
+    write_heartbeat(hb, 1, 450.0)    # peer: 50s stale — the culprit
+    # rank 2 never wrote a heartbeat at all
+    diag = g.diagnose("gather")
+    assert "rank 1 last seen 50.0s ago" in diag
+    assert "rank 2 never heartbeat" in diag
+    assert "rank 0 last seen" not in diag
+
+
+def test_fresh_heartbeats_reported_as_fresh(tmp_path):
+    hb = str(tmp_path / "hb")
+    wall = FakeClock(500.0)
+    g = CollectiveGuard(10.0, rank=0, world=2, heartbeat_dir=hb,
+                        heartbeat_interval_s=1.0, wall=wall)
+    write_heartbeat(hb, 0, 500.0)
+    write_heartbeat(hb, 1, 499.5)
+    assert "heartbeats fresh" in g.diagnose("gather")
+
+
+def test_diagnosis_without_heartbeat_dir():
+    g = CollectiveGuard(10.0, rank=1, world=2)
+    diag = g.diagnose("gather")
+    assert "rank 1" in diag and "heartbeat_dir" in diag
+
+
+# ---------------------------------------------------------------------------
+# disabled-by-default contracts (the tier-1 fast path)
+
+def test_guard_disabled_by_default_on_one_machine():
+    cfg = param_dict_to_config({"verbosity": -1})
+    assert cfg.collective_timeout_s == 0.0
+    assert maybe_start_watchdog(cfg) is None
+    assert active_guard() is None
+    # explicit timeout, but a single process: still no guard
+    cfg2 = param_dict_to_config(
+        {"collective_timeout_s": 5.0, "verbosity": -1})
+    assert maybe_start_watchdog(cfg2) is None
+    assert active_guard() is None
+
+
+def test_configure_watchdog_needs_world_and_timeout():
+    assert configure_watchdog(0.0, world=8) is None
+    assert configure_watchdog(10.0, world=1) is None
+    assert active_guard() is None
+    with pytest.raises(ValueError):
+        CollectiveGuard(0.0)
+
+
+def test_collective_guard_noop_without_guard():
+    assert active_guard() is None
+    with collective_guard("anything"):
+        pass                          # must not raise, log, or record
+
+
+def test_single_process_coordinator_is_none():
+    assert checkpoint_coordinator() is None
+
+
+# ---------------------------------------------------------------------------
+# guarded_allgather: the bracketed choke point
+
+def test_guarded_allgather_single_process_identity():
+    out = guarded_allgather(np.arange(6).reshape(2, 3), label="t")
+    np.testing.assert_array_equal(np.asarray(out).reshape(2, 3),
+                                  np.arange(6).reshape(2, 3))
+
+
+def test_guarded_allgather_carries_collective_psum_site():
+    with faults.injected("collective_psum", fail=1):
+        with pytest.raises(InjectedFault):
+            guarded_allgather(np.zeros(3))
+    # schedule consumed: next call clean
+    np.asarray(guarded_allgather(np.zeros(3)))
+
+
+def test_checkpoint_agree_single_process():
+    out = checkpoint_agree(17)
+    assert list(np.asarray(out).reshape(-1)) == [17]
+
+
+def test_injected_fault_passes_guard_bracket_silently():
+    clk = FakeClock()
+    g = CollectiveGuard(10.0, world=2, clock=clk)
+    with pytest.raises(InjectedFault):
+        with g.guard("site"):
+            raise InjectedFault("collective_psum")
+    assert g.poll() is None           # bracket was closed on the way out
+
+
+def test_other_exceptions_reraise_with_diagnosis(tmp_path, capsys):
+    hb = str(tmp_path / "hb")
+    wall = FakeClock(500.0)
+    g = CollectiveGuard(10.0, rank=0, world=2, heartbeat_dir=hb,
+                        heartbeat_interval_s=1.0, wall=wall)
+    write_heartbeat(hb, 1, 480.0)
+    with pytest.raises(RuntimeError, match="boom"):
+        with g.guard("site"):
+            raise RuntimeError("boom")
+    err = capsys.readouterr().err
+    assert "rank 1 last seen" in err
+
+
+# ---------------------------------------------------------------------------
+# monitor thread (real time, stubbed abort — nothing calls os._exit)
+
+def test_monitor_thread_fires_stubbed_abort(tmp_path):
+    fired = threading.Event()
+    seen = {}
+
+    def _abort(diag):
+        seen["diag"] = diag
+        fired.set()
+
+    before = registry.collective_snapshot()
+    g = CollectiveGuard(0.08, rank=0, world=2,
+                        heartbeat_dir=str(tmp_path / "hb"),
+                        heartbeat_interval_s=0.02,
+                        first_deadline_factor=1.0, abort_fn=_abort)
+    g.start()
+    try:
+        g.enter("gather")
+        assert fired.wait(timeout=10.0), "watchdog monitor never fired"
+    finally:
+        g.exit_()
+        g.stop()
+    assert "gather" in seen["diag"]
+    after = registry.collective_snapshot()
+    assert after["timeouts"] > before["timeouts"]
+    assert after["aborts"] > before["aborts"]
+
+
+def test_exit_codes_are_distinct_and_nonzero():
+    assert WATCHDOG_EXIT_CODE != RANK_DEATH_EXIT_CODE
+    assert WATCHDOG_EXIT_CODE not in (0, 1)
+    assert RANK_DEATH_EXIT_CODE not in (0, 1)
+
+
+# ---------------------------------------------------------------------------
+# rank_death fault mode (the chaos harness's kill switch)
+
+def test_rank_death_mode_fires_exit_hook(monkeypatch):
+    killed = []
+    monkeypatch.setattr(faults_mod, "_rank_death_exit", killed.append)
+    faults.schedule("collective_psum", fail=1, skip=1,
+                    mode="rank_death")
+    faults.inject("collective_psum")          # skip consumed, alive
+    assert killed == []
+    faults.inject("collective_psum")          # fires: "dies" here
+    assert killed == ["collective_psum"]
+    faults.inject("collective_psum")          # schedule consumed
+    assert killed == ["collective_psum"]
+    assert faults.trips("collective_psum") == 1
+
+
+def test_rank_death_env_suffix(monkeypatch):
+    killed = []
+    monkeypatch.setattr(faults_mod, "_rank_death_exit", killed.append)
+    monkeypatch.setenv("LGBM_TPU_TEST_RD", "1:1:rank_death")
+    faults.schedule_from_env("collective_psum", "LGBM_TPU_TEST_RD")
+    assert faults.remaining("collective_psum") == (1, 1)
+    faults.inject("collective_psum")
+    faults.inject("collective_psum")
+    assert killed == ["collective_psum"]
+
+
+def test_unknown_fault_mode_rejected():
+    with pytest.raises(ValueError, match="rank_death"):
+        faults.schedule("collective_psum", fail=1, mode="explode")
+
+
+# ---------------------------------------------------------------------------
+# observability surface
+
+def test_collective_family_in_snapshot_and_prometheus():
+    snap = registry.snapshot()
+    assert set(snap["collective"]) == {
+        "guarded", "wall_seconds", "timeouts", "aborts",
+        "heartbeat_age_max_s", "world"}
+    text = registry.prometheus_text()
+    assert "lightgbm_tpu_collective_guarded" in text
+    assert "lightgbm_tpu_collective_timeouts" in text
