@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-all faults chaos observe lint lint-sarif pipeline kernels stream bench install
+.PHONY: test test-slow test-all faults chaos postmortem observe lint lint-sarif pipeline kernels stream bench install
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -59,6 +59,13 @@ faults:
 chaos:
 	$(PY) -m pytest tests/test_chaos.py -x -q -m chaos
 
+# the flight-recorder acceptance scenario: the 2-rank kill run must
+# leave a postmortem_<rank>.json on BOTH ranks naming the hung
+# collective site (tests/test_chaos.py::test_postmortem_bundles,
+# docs/Observability.md "Post-mortem workflow")
+postmortem:
+	$(PY) -m pytest tests/test_chaos.py -x -q -m chaos -k postmortem
+
 # the observability tier: spans, training telemetry, MFU accounting,
 # Prometheus /metrics (tests/test_observability.py, docs/Observability.md)
 observe:
@@ -85,8 +92,12 @@ test-slow:
 
 test-all: test test-slow
 
+# the bench run, followed by the regression sentinel: the fresh record
+# is compared against the BENCH_r*/MULTICHIP_r* trajectory and a >10%
+# drop vs best-so-far fails the target (observability/regress.py)
 bench:
 	$(PY) bench.py
+	$(PY) bench.py --compare --strict
 
 install:
 	pip install -e . --no-build-isolation --no-deps

@@ -415,6 +415,30 @@ def _stream_bench(result, spec):
               file=sys.stderr)
 
 
+def _compare_main(argv):
+    """``bench.py --compare [--strict] [--trajectory-dir D]``: the bench
+    regression sentinel (lightgbm_tpu/observability/regress.py) — check
+    the BENCH_r*/MULTICHIP_r* trajectory for per-metric drops beyond
+    the threshold. Pure record reading: no dataset, no accelerator, no
+    probe — safe to run anywhere, including the `make bench` tail.
+    With --strict, regressions exit nonzero."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from lightgbm_tpu.observability import regress
+    root = None
+    for i, a in enumerate(argv):
+        if a == "--trajectory-dir":
+            if i + 1 >= len(argv):
+                raise SystemExit("--trajectory-dir needs a path")
+            root = argv[i + 1]
+        elif a.startswith("--trajectory-dir="):
+            root = a[len("--trajectory-dir="):]
+    result = regress.compare(root)
+    print(json.dumps({"bench_regressions": result}))
+    sys.stdout.flush()
+    print(regress.render_compare(result), file=sys.stderr)
+    return 1 if ("--strict" in argv and result["regressions"]) else 0
+
+
 def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     result = {"metric": "higgs1m_trees_per_sec", "value": 0.0,
@@ -612,6 +636,8 @@ def _report(result, block_times, block_trees, bench):
 
 
 if __name__ == "__main__":
+    if "--compare" in sys.argv[1:]:
+        sys.exit(_compare_main(sys.argv[1:]))
     _result, _blocks, _bt, _bench = main()
     print(json.dumps(_result))
     sys.stdout.flush()

@@ -267,6 +267,7 @@ def all_rules() -> List[Rule]:
                             JitPythonControlFlowRule,
                             JitStaticScalarRule)
     from .rules_lock import LockDisciplineRule, LockOrderRule
+    from .rules_obs import ObservabilityBracketRule
     from .rules_pallas import PallasKernelRule
     from .rules_registry import (CliTaskRoutingRule, ConfigAttrRule,
                                  FaultSiteRegistryRule, ParamDocsRule,
@@ -278,6 +279,7 @@ def all_rules() -> List[Rule]:
         JitHostSyncRule(), JitDonationReuseRule(),
         DtypeF64Rule(), DtypePromotionRule(),
         LockDisciplineRule(), LockOrderRule(),
+        ObservabilityBracketRule(),
         PallasKernelRule(),
         ParamDocsRule(), CliTaskRoutingRule(), ConfigAttrRule(),
         FaultSiteRegistryRule(), PrometheusDocsRule(),

@@ -680,10 +680,8 @@ class Booster:
         self.params = dict(params or {})
         self.config = param_dict_to_config(self.params)
         Log.set_verbosity(self.config.verbosity)
-        if self.config.observe:
-            from .observability import registry as _obs
-            _obs.enable(ring=self.config.observe_ring,
-                        norms=self.config.observe_norms)
+        from .observability import registry as _obs
+        _obs.configure_from_config(self.config)
         self._model = None          # HostModel once finalized/loaded
         self.gbdt = None
         self.train_set = None

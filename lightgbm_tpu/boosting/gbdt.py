@@ -34,6 +34,7 @@ from ..learner.split import SplitHyperParams
 from ..metrics import Metric
 from ..objectives import ObjectiveFunction
 from ..observability import registry as _obs
+from ..observability.profile import profiler as _profiler
 from ..reliability import counters, faults, guards, retry_call
 from ..utils.log import Log, LightGBMError
 from ..utils.timer import global_timer
@@ -704,20 +705,33 @@ class GBDT:
         def _attempt():
             faults.inject("histogram_build")
             if self._grower is None:
-                return self._grow_impl(g, h, cnt, feature_mask)
+                # device-profiler bracket (profile_spans=grow_tree): a
+                # live capture forces a block_until_ready so the trace
+                # window covers the async device work; otherwise the
+                # dispatch stays fully async
+                with _profiler.capture("grow_tree") as capturing:
+                    out = self._grow_impl(g, h, cnt, feature_mask)
+                    if capturing:
+                        jax.block_until_ready(out)
+                return out
             from ..parallel.comm import check_collective_fault
             from ..reliability.watchdog import active_guard
             check_collective_fault()
             guard = active_guard()
             if guard is None:
-                return self._grow_impl(g, h, cnt, feature_mask)
+                with _profiler.capture("sharded_grow") as capturing:
+                    out = self._grow_impl(g, h, cnt, feature_mask)
+                    if capturing:
+                        jax.block_until_ready(out)
+                return out
             # JAX dispatch is async: a peer dying mid-psum hangs the
             # host at the first result *read*, not the launch — so the
             # deadline bracket must cover block_until_ready, or the
             # watchdog would never see the stall
             with guard.guard("sharded_grow"):
-                out = self._grow_impl(g, h, cnt, feature_mask)
-                jax.block_until_ready(out)
+                with _profiler.capture("sharded_grow"):
+                    out = self._grow_impl(g, h, cnt, feature_mask)
+                    jax.block_until_ready(out)
             return out
 
         return retry_call(_attempt, attempts=cfg.retry_max_attempts,

@@ -119,6 +119,11 @@ class Application:
         self.params = _parse_argv(argv)
         self.config = Config(self.params)
         Log.set_verbosity(self.config.verbosity)
+        # arm the flight recorder as soon as the config exists — a
+        # failure before any Booster is built (bad data path, schema
+        # error) must still honor flightrec_dir= for its bundle
+        from .observability.registry import registry
+        registry.configure_from_config(self.config)
 
     def run(self) -> None:
         task = self.config.task
@@ -482,6 +487,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         Application(argv).run()
     except Exception as e:  # mirror main.cpp catch-all
         Log.warning("Met Exceptions: %s", str(e))
+        from .observability.flightrec import recorder as _flightrec
+        _flightrec.record_exception("cli.main", e)
+        _flightrec.flush("exception")
         raise
     return 0
 

@@ -220,6 +220,34 @@ _PARAMS: List[ParamSpec] = [
        desc="serve Prometheus text-format metrics on this localhost "
             "port during task=train or task=serve (0 = off; serving "
             "picks an ephemeral port when 0 and observe is on)"),
+    _p("profile_spans", str, "", (),
+       desc="comma-separated fnmatch globs of span names to bracket "
+            "with a jax.profiler device trace (e.g. "
+            "'pipeline_block,sharded_grow'). Empty (default) disables "
+            "device capture; degrades to a logged no-op where the "
+            "profiler is unavailable. Implies observe"),
+    _p("profile_dir", str, "", (),
+       desc="directory for device-profiler captures (one subdirectory "
+            "per capture); defaults to ./jax_profile when profile_spans "
+            "is set"),
+    _p("profile_max_captures", int, 4, (), lambda v: v >= 1,
+       desc="hard budget of device-profiler captures per process — a "
+            "long run collects a handful of representative windows "
+            "instead of gigabytes"),
+    _p("flightrec", bool, True, ("flight_recorder",),
+       desc="crash flight recorder: keep a bounded ring of recent "
+            "spans, collective brackets, fault hits and guard trips, "
+            "flushed as postmortem_<rank>.json on watchdog abort, "
+            "injected rank death, non-finite guard trips and unhandled "
+            "training exceptions. Always on (even with observe=false); "
+            "the ring costs one dict append per recorded event"),
+    _p("flightrec_ring", int, 256, (), lambda v: v >= 16,
+       desc="flight-recorder ring capacity (recent events retained for "
+            "the post-mortem bundle; oldest evicted)"),
+    _p("flightrec_dir", str, "", (),
+       desc="directory for postmortem_<rank>.json bundles; defaults to "
+            "checkpoint_dir when set (shared storage in a multihost "
+            "run), else the working directory on fatal flushes only"),
     # ---- Reliability (lightgbm_tpu/reliability/, docs/Reliability.md) ----
     _p("checkpoint_period", int, 0, ("checkpoint_freq", "snapshot_period"),
        lambda v: v >= 0),
@@ -570,7 +598,8 @@ class Config:
                 "collective_timeout_s is set but num_machines <= 1; "
                 "the collective watchdog only arms on multihost runs")
         if (self.observe_trace_file or self.observe_norms or
-                self.observe_metrics_port > 0) and not self.observe:
+                self.observe_metrics_port > 0 or
+                self.profile_spans) and not self.observe:
             # asking for an observability output implies observing
             self.observe = True
         if self.serve_max_bucket < self.serve_min_bucket:

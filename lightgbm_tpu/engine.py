@@ -366,6 +366,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
             if base_model is not None and resume_state is None else 0
         booster.best_iteration = base_iters + es.best_iteration + 1
         evaluation_result_list = es.best_score
+    except Exception as exc:
+        # unhandled training failure: leave a flight-recorder bundle
+        # (when a bundle directory is configured) before propagating
+        from .observability.flightrec import recorder as _flightrec
+        _flightrec.record_exception("engine.train", exc)
+        _flightrec.flush("exception")
+        raise
     if booster.best_iteration < 0:
         booster.best_iteration = booster.current_iteration()
     try:

@@ -17,7 +17,17 @@ grew separately — `utils.timer.global_timer` (phase totals),
 - compile accounting (compile count/seconds per jitted entry,
   shape-bucket hits — the serving bucket-cache semantics);
 - exporters: `registry.snapshot()` JSON dict, Prometheus text format
-  (served from `serving/server.py` at /metrics), `dump_trace(path)`.
+  (served from `serving/server.py` at /metrics), `dump_trace(path)`;
+- a crash flight recorder (`recorder`, flightrec.py): bounded ring of
+  recent spans / collective brackets / fault hits / guard trips,
+  flushed as an atomic ``postmortem_<rank>.json`` on fatal paths;
+- budgeted device-profiler capture (`profiler`, profile.py) bracketing
+  jax.profiler traces around spans matching ``profile_spans``;
+- cross-rank trace merge (merge.py, ``python -m
+  lightgbm_tpu.observability merge <dir>``) aligning per-rank clocks
+  from samples piggybacked on guarded collectives;
+- the bench regression sentinel (regress.py, ``bench.py --compare``)
+  checking the BENCH_r*/MULTICHIP_r* trajectory for perf drops.
 
 The registry is disabled by default; every instrumentation site is a
 single `if registry.enabled:` branch, so the off path costs one
@@ -34,6 +44,9 @@ from __future__ import annotations
 from . import mfu
 from .compiles import CompileAccounting
 from .export import MetricsHTTPServer, prometheus_lines
+from .flightrec import FlightRecorder, recorder
+from .merge import merge_traces
+from .profile import SpanProfiler, profiler
 from .registry import ObservabilityRegistry, registry
 from .telemetry import TrainingTelemetry
 from .trace import Span, Trace
@@ -43,6 +56,8 @@ __all__ = [
     "TrainingTelemetry", "CompileAccounting", "MetricsHTTPServer",
     "prometheus_lines", "mfu", "span", "snapshot", "dump_trace",
     "prometheus_text", "enable", "disable",
+    "FlightRecorder", "recorder", "SpanProfiler", "profiler",
+    "merge_traces",
 ]
 
 # module-level conveniences bound to the process-global registry

@@ -24,14 +24,17 @@ the iteration into the span trace.
 
 from __future__ import annotations
 
+import collections as _collections
 import threading
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from ..reliability.counters import counters as _rel_counters
 from ..utils.timer import global_timer as _global_timer
 from .compiles import CompileAccounting
 from .export import render_prometheus
+from .flightrec import current_rank, recorder as _flightrec
 from .mfu import DeviceUtilization, tree_macs
+from .profile import profiler as _profiler
 from .telemetry import PHASE_KEYS, TrainingTelemetry
 from .trace import Trace
 
@@ -69,6 +72,13 @@ class ObservabilityRegistry:
         self._collective = {"guarded": 0, "wall_seconds": 0.0,
                             "timeouts": 0, "aborts": 0,
                             "heartbeat_age_max_s": 0.0, "world": 0}
+        # cross-rank clock-offset samples piggybacked on guarded
+        # collectives (parallel/comm.py): aggregates for /metrics plus
+        # a bounded sample ring the trace dump embeds for the merge CLI
+        self._clock_skew = {"samples": 0, "last_skew_s": 0.0,
+                            "max_skew_s": 0.0}
+        self._clock_samples: "collections.deque" = \
+            _collections.deque(maxlen=512)
         # shared singletons, NOT copies — existing call sites in
         # serving/, reliability/ and the phase timeits keep writing to
         # the same objects this registry reads.
@@ -92,6 +102,24 @@ class ObservabilityRegistry:
             self.enabled = False
             self.trace.enabled = False
 
+    def configure_from_config(self, cfg) -> None:
+        """Wire the whole observability surface from a resolved Config
+        (Booster.__init__): registry enable flag, flight-recorder ring
+        and bundle directory (falling back to the checkpoint directory
+        so multihost post-mortems land on shared storage), and the
+        device span profiler."""
+        if cfg.observe:
+            self.enable(ring=cfg.observe_ring,
+                        norms=cfg.observe_norms)
+        _flightrec.configure(
+            enabled=bool(cfg.flightrec),
+            capacity=int(cfg.flightrec_ring),
+            out_dir=cfg.flightrec_dir or cfg.checkpoint_dir or "")
+        if cfg.profile_spans:
+            _profiler.configure(spans=cfg.profile_spans,
+                                out_dir=cfg.profile_dir,
+                                max_captures=cfg.profile_max_captures)
+
     def reset(self) -> None:
         """Clear observability-owned state. The shared timer and
         reliability counters are left alone — they predate this
@@ -111,6 +139,9 @@ class ObservabilityRegistry:
             self._collective = {"guarded": 0, "wall_seconds": 0.0,
                                 "timeouts": 0, "aborts": 0,
                                 "heartbeat_age_max_s": 0.0, "world": 0}
+            self._clock_skew = {"samples": 0, "last_skew_s": 0.0,
+                                "max_skew_s": 0.0}
+            self._clock_samples = _collections.deque(maxlen=512)
 
     # -- exporters ------------------------------------------------------
     def pipeline_snapshot(self) -> Dict:
@@ -155,10 +186,27 @@ class ObservabilityRegistry:
         c["heartbeat_age_max_s"] = round(c["heartbeat_age_max_s"], 3)
         return c
 
+    def clock_skew_snapshot(self) -> Dict:
+        with self._lock:
+            s = dict(self._clock_skew)
+        s["last_skew_s"] = round(s["last_skew_s"], 6)
+        s["max_skew_s"] = round(s["max_skew_s"], 6)
+        return s
+
+    def clock_samples(self) -> List[Dict]:
+        """The bounded ring of piggybacked clock-offset samples
+        ({"site", "walls"}) that the chrome trace dump embeds for
+        ``python -m lightgbm_tpu.observability merge``."""
+        with self._lock:
+            return list(self._clock_samples)
+
     def snapshot(self) -> Dict:
         return {
             "enabled": self.enabled,
+            "clock_skew": self.clock_skew_snapshot(),
             "collective": self.collective_snapshot(),
+            "flightrec": _flightrec.snapshot(),
+            "profiler": _profiler.snapshot(),
             "hist_backend": self.hist_backend_snapshot(),
             "pipeline": self.pipeline_snapshot(),
             "streaming": self.streaming_snapshot(),
@@ -185,6 +233,8 @@ class ObservabilityRegistry:
             (snap["device_utilization"], "lightgbm_tpu_device", None),
             (snap["counters"], "lightgbm_tpu_reliability", None),
             (snap["collective"], "lightgbm_tpu_collective", None),
+            (snap["clock_skew"], "lightgbm_tpu_clock_skew", None),
+            (snap["flightrec"], "lightgbm_tpu_flightrec", None),
             (snap["hist_backend"], "lightgbm_tpu_hist_backend", None),
             (snap["pipeline"], "lightgbm_tpu_pipeline", None),
             (snap["streaming"], "lightgbm_tpu_streaming", None),
@@ -193,7 +243,8 @@ class ObservabilityRegistry:
         ])
 
     def dump_trace(self, path: str, fmt: Optional[str] = None) -> str:
-        return self.trace.dump(path, fmt)
+        return self.trace.dump(path, fmt, rank=current_rank(),
+                               clock_samples=self.clock_samples())
 
     # -- training hooks (called from boosting/gbdt.py) ------------------
     def record_hist_autotune(self, choice: str, timings_ms: Dict,
@@ -233,6 +284,24 @@ class ObservabilityRegistry:
     def record_collective_world(self, world: int) -> None:
         with self._lock:
             self._collective["world"] = int(world)
+
+    def record_clock_sample(self, site: str, walls) -> None:
+        """One piggybacked clock-offset sample from a guarded collective
+        (parallel/comm.py): every rank's pre-collective wall stamp, one
+        float per rank, moved by the SAME allgather as the payload.
+        Recorded even when disabled, like the other collective hooks —
+        skew forensics must survive the enable flag."""
+        w = [float(v) for v in walls]
+        if not w:
+            return
+        skew = (max(w) - min(w)) if len(w) > 1 else 0.0
+        with self._lock:
+            self._clock_skew["samples"] += 1
+            self._clock_skew["last_skew_s"] = skew
+            self._clock_skew["max_skew_s"] = max(
+                self._clock_skew["max_skew_s"], skew)
+            self._clock_samples.append({"site": str(site), "walls": w})
+        _flightrec.record_clock_sample(site, w)
 
     def tree_macs_for(self, gbdt) -> int:
         """Analytic per-tree MAC estimate for this booster's config;

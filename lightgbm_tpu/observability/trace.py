@@ -23,7 +23,10 @@ import json
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
+
+from .flightrec import recorder as _flightrec
+from .profile import profiler as _profiler
 
 __all__ = ["Span", "Trace"]
 
@@ -91,15 +94,25 @@ class Trace:
         self._lock = threading.Lock()
         self._ring = collections.deque(maxlen=max(int(capacity), 16))
         self._local = threading.local()
+        # open-span stacks by thread id (the same list objects as the
+        # threading.local stacks) so the watchdog's heartbeat thread can
+        # name another thread's innermost open span
+        self._open: Dict[int, List["Span"]] = {}
         self._epoch = time.perf_counter()
+        self._epoch_wall = time.time()   # same instant, wall clock —
         self.dropped = 0          # spans evicted from the ring
 
     # ------------------------------------------------------------------
     def span(self, name: str, **attrs):
-        """Context manager timing a region; no-op when disabled."""
+        """Context manager timing a region; no-op when disabled. When
+        the device profiler is armed for this span name, the region is
+        additionally bracketed in a jax.profiler capture."""
         if not self.enabled:
             return _NULL_SPAN
-        return Span(self, name, attrs)
+        sp = Span(self, name, attrs)
+        if _profiler.armed and _profiler.matches(name):
+            return _ProfiledSpan(sp, name)
+        return sp
 
     def add(self, name: str, start: float, duration: float, **attrs):
         """Record an already-measured region (hot-path hooks measure
@@ -115,7 +128,27 @@ class Trace:
         stack = getattr(self._local, "stack", None)
         if stack is None:
             stack = self._local.stack = []
+            with self._lock:
+                self._open[threading.get_ident()] = stack
         return stack
+
+    def innermost_open(self) -> Tuple[str, float]:
+        """(name, age_s) of the most recently opened span still open on
+        ANY thread; ("", 0.0) when nothing is open. Read cross-thread
+        for the watchdog heartbeat payload: stacks are only appended/
+        popped under the GIL, so a stale read costs at most one span of
+        accuracy in a diagnostic."""
+        with self._lock:
+            stacks = list(self._open.values())
+        best: Optional[Span] = None
+        for stack in stacks:
+            if stack:
+                top = stack[-1]
+                if best is None or top.start > best.start:
+                    best = top
+        if best is None:
+            return "", 0.0
+        return best.name, max(0.0, time.perf_counter() - best.start)
 
     def _append(self, name, start, duration, depth, parent, attrs):
         rec = {
@@ -134,6 +167,9 @@ class Trace:
             if len(self._ring) == self._ring.maxlen:
                 self.dropped += 1
             self._ring.append(rec)
+        # span-close tap for the crash flight recorder (bounded ring,
+        # survives as the postmortem timeline — flightrec.py)
+        _flightrec.record_span(name, start, duration, depth, parent)
 
     # ------------------------------------------------------------------
     def set_capacity(self, capacity: int) -> None:
@@ -154,14 +190,33 @@ class Trace:
             self._ring.clear()
             self.dropped = 0
             self._epoch = time.perf_counter()
+            self._epoch_wall = time.time()
+
+    @property
+    def epoch_wall(self) -> float:
+        """Wall-clock instant of the trace epoch: the anchor the
+        cross-rank merge (observability/merge.py) uses to place this
+        rank's perf_counter-relative timestamps on a shared timeline."""
+        with self._lock:
+            return self._epoch_wall
 
     # ------------------------------------------------------------------
     # export
-    def to_chrome_trace(self) -> Dict:
+    def to_chrome_trace(self, rank: Optional[int] = None,
+                        clock_samples: Optional[List[Dict]] = None
+                        ) -> Dict:
         """Chrome/Perfetto `trace_event` format: "X" complete events,
-        microsecond timestamps (chrome://tracing, ui.perfetto.dev)."""
+        microsecond timestamps (chrome://tracing, ui.perfetto.dev).
+        With `rank`, the document gains rank-tagged process_name
+        metadata and a ``lightgbm_tpu_meta`` block (rank, wall-clock
+        epoch, piggybacked clock-offset samples) that
+        ``python -m lightgbm_tpu.observability merge`` consumes."""
         pid = os.getpid()
         events = []
+        if rank is not None:
+            events.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "tid": 0,
+                           "args": {"name": f"lightgbm_tpu rank {rank}"}})
         for rec in self.spans():
             ev = {
                 "name": rec["name"],
@@ -178,12 +233,21 @@ class Trace:
             if args:
                 ev["args"] = args
             events.append(ev)
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        doc: Dict = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if rank is not None:
+            doc["lightgbm_tpu_meta"] = {
+                "rank": int(rank),
+                "epoch_wall": self.epoch_wall,
+                "clock_samples": list(clock_samples or ()),
+            }
+        return doc
 
     def to_jsonl(self) -> str:
         return "\n".join(json.dumps(rec) for rec in self.spans())
 
-    def dump(self, path: str, fmt: Optional[str] = None) -> str:
+    def dump(self, path: str, fmt: Optional[str] = None,
+             rank: Optional[int] = None,
+             clock_samples: Optional[List[Dict]] = None) -> str:
         """Write the ring to `path`. fmt: "jsonl" | "chrome"; default
         by extension (.jsonl -> JSONL, anything else -> Chrome JSON).
         Returns the format written."""
@@ -194,6 +258,30 @@ class Trace:
                 fh.write(self.to_jsonl())
                 fh.write("\n")
             else:
-                json.dump(self.to_chrome_trace(), fh)
+                json.dump(self.to_chrome_trace(
+                    rank=rank, clock_samples=clock_samples), fh)
                 fh.write("\n")
         return fmt
+
+
+class _ProfiledSpan:
+    """A Span whose region is additionally captured by the device
+    profiler (observability/profile.py). Entering starts the
+    jax.profiler trace first so it covers the whole span."""
+
+    __slots__ = ("_span", "_name", "_started")
+
+    def __init__(self, span: Span, name: str):
+        self._span = span
+        self._name = name
+        self._started = False
+
+    def __enter__(self) -> Span:
+        self._started = _profiler.begin(self._name)
+        return self._span.__enter__()
+
+    def __exit__(self, *exc) -> bool:
+        out = self._span.__exit__(*exc)
+        if self._started:
+            _profiler.end()
+        return out

@@ -48,7 +48,14 @@ def guarded_allgather(x, label: str = "allgather") -> np.ndarray:
     and the collective-watchdog deadline bracket. A peer that died
     before this call leaves us blocked inside `process_allgather`; the
     watchdog deadline turns that into a named "rank k last seen Ns ago"
-    abort instead of an eternal hang."""
+    abort instead of an eternal hang.
+
+    Each call also piggybacks one wall-clock stamp per rank on the SAME
+    pytree allgather (one extra float64 on the wire, zero extra
+    collectives): the samples feed the cross-rank clock alignment of
+    ``python -m lightgbm_tpu.observability merge`` and the
+    lightgbm_tpu_clock_skew metrics."""
+    import time
     from jax.experimental import multihost_utils
     from ..reliability.watchdog import collective_guard
     check_collective_fault()
@@ -57,7 +64,22 @@ def guarded_allgather(x, label: str = "allgather") -> np.ndarray:
         arr = np.ascontiguousarray(arr)   # changing the wire shape
 
     with collective_guard(label):
-        return np.asarray(multihost_utils.process_allgather(arr))
+        gathered, walls = multihost_utils.process_allgather(
+            (arr, np.float64(time.time())))
+    _record_clock_sample(label, walls)
+    return np.asarray(gathered)
+
+
+def _record_clock_sample(label: str, walls) -> None:
+    """Feed one piggybacked clock sample (every rank's pre-collective
+    wall stamp) to the observability registry; never raises — clock
+    forensics must not fail the collective that carried them."""
+    try:
+        from ..observability.registry import registry
+        registry.record_clock_sample(label,
+                                     np.asarray(walls).reshape(-1))
+    except Exception:       # pragma: no cover - forensics only
+        pass
 
 
 def checkpoint_agree(value: int, label: str = "checkpoint_agree"

@@ -37,6 +37,7 @@ import numpy as np
 
 from ..callback import EarlyStopException
 from ..observability import registry as _obs
+from ..observability.profile import profiler as _profiler
 from .device_eval import build_device_eval
 from .scheduler import AdaptiveBlockScheduler
 
@@ -110,10 +111,17 @@ def run_pipelined(booster, *, start_iter: int, num_boost_round: int,
             b = sched.next_block(num_boost_round - i)
             was_built = getattr(gb, "_fused_run", None) is None
             t0 = time.perf_counter()
-            handle = booster.update_batch_dispatch(b)
-            traj = getattr(gb, "_fused_valid_traj", None)
-            mx = dev.dispatch(traj) \
-                if dev is not None and traj is not None else None
+            with _profiler.capture("pipeline_block") as _capturing:
+                handle = booster.update_batch_dispatch(b)
+                traj = getattr(gb, "_fused_valid_traj", None)
+                mx = dev.dispatch(traj) \
+                    if dev is not None and traj is not None else None
+                if _capturing:
+                    # live device capture: force the async block to
+                    # complete inside the trace window (costs the
+                    # overlap for this one profiled block only)
+                    import jax
+                    jax.block_until_ready((handle, traj, mx))
             t1 = time.perf_counter()
             # ---- overlapped host window: the previous block's trees
             # unpack while this block runs on device

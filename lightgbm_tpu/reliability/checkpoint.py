@@ -46,6 +46,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..observability.flightrec import recorder
 from ..utils.log import Log, LightGBMError
 from .counters import counters
 from .faults import faults
@@ -186,6 +187,7 @@ def save_checkpoint(ckpt_dir: str, iteration: int, model_str: str,
     if keep_last and keep_last > 0:
         _prune(ckpt_dir, keep_last)
     counters.inc("checkpoint_saves")
+    recorder.record_checkpoint("checkpoint_save", iteration, final)
     Log.info(f"checkpoint: saved iteration {iteration} -> {final}")
     return final
 
@@ -250,6 +252,7 @@ def _save_coordinated(ckpt_dir: str, iteration: int, model_str: str,
         if keep_last and keep_last > 0:
             _prune(ckpt_dir, keep_last)
     counters.inc("checkpoint_saves")
+    recorder.record_checkpoint("checkpoint_commit", agreed, final)
     Log.info(f"checkpoint: rank {rank}/{world} committed iteration "
              f"{agreed} -> {final}")
     return final

@@ -105,6 +105,10 @@ def _rank_death_exit(site: str) -> None:
     print(f"lightgbm_tpu: injected rank_death at site '{site}' "
           f"(os._exit({RANK_DEATH_EXIT_CODE}))", file=sys.stderr,
           flush=True)
+    # the killed rank's last act: leave a postmortem bundle so the
+    # chaos harness sees a timeline, not just exit code 86
+    from ..observability.flightrec import recorder
+    recorder.flush("rank_death")
     os._exit(RANK_DEATH_EXIT_CODE)
 
 
@@ -199,6 +203,8 @@ class FaultRegistry:
             else:
                 del self._schedules[site]
                 return
+        from ..observability.flightrec import recorder
+        recorder.record_fault(site, mode or "raise")
         if mode == "rank_death":
             _rank_death_exit(site)
             return      # only reachable when _rank_death_exit is stubbed

@@ -50,6 +50,9 @@ def trip(what: str, policy: str, iteration: int) -> None:
     """Record a guard activation and apply the terminal part of the
     policy (logging / raising); the caller implements skip/rollback."""
     counters.inc("guard_trips")
+    from ..observability.flightrec import recorder
+    recorder.record_guard_trip(what, policy, iteration)
+    recorder.flush("guard_nonfinite")
     msg = (f"non-finite {what} detected at iteration {iteration} "
            f"(guard_nonfinite={policy})")
     if policy == "raise":

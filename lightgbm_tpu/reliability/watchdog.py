@@ -38,8 +38,9 @@ import sys
 import threading
 import time
 from contextlib import contextmanager
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
+from ..observability.flightrec import recorder as _flightrec
 from ..utils.log import Log
 from .faults import InjectedFault
 
@@ -47,7 +48,7 @@ __all__ = [
     "CollectiveGuard", "WATCHDOG_EXIT_CODE", "FIRST_DEADLINE_FACTOR",
     "active_guard", "collective_guard", "configure_watchdog",
     "maybe_start_watchdog", "shutdown_watchdog",
-    "read_heartbeats", "write_heartbeat",
+    "read_heartbeats", "read_heartbeat_info", "write_heartbeat",
 ]
 
 #: exit status of a watchdog abort — distinct from RANK_DEATH_EXIT_CODE
@@ -64,35 +65,58 @@ _HB_PREFIX = "hb_rank_"
 
 
 # ----------------------------------------------------------------------
-# heartbeat files: tmp+replace so readers never see a torn stamp
-def write_heartbeat(heartbeat_dir: str, rank: int, now: float) -> None:
-    """Stamp `rank`'s liveness at wall-clock `now` (atomic replace)."""
+# heartbeat files: tmp+replace so readers never see a torn stamp.
+# Line 1 is the wall-clock stamp (the original single-line format);
+# line 2, when present, is "<span_age_s> <span_name>" — what this rank
+# was doing when it last stamped, so a peer diagnosing a hang can say
+# *where* the quiet rank was, not just when it was last seen.
+def write_heartbeat(heartbeat_dir: str, rank: int, now: float,
+                    span_name: str = "", span_age: float = 0.0) -> None:
+    """Stamp `rank`'s liveness at wall-clock `now` (atomic replace),
+    optionally tagged with the rank's innermost open span."""
     os.makedirs(heartbeat_dir, exist_ok=True)
     path = os.path.join(heartbeat_dir, f"{_HB_PREFIX}{rank:03d}")
     tmp = f"{path}.tmp-{os.getpid()}"
     with open(tmp, "w") as f:
         f.write(repr(float(now)))
+        if span_name:
+            f.write(f"\n{span_age:.3f} {span_name}")
     os.replace(tmp, path)
 
 
-def read_heartbeats(heartbeat_dir: str) -> Dict[int, float]:
-    """{rank: last wall-clock stamp} for every readable heartbeat file.
-    Tolerates concurrent writers and vanishing files (ENOENT races)."""
-    stamps: Dict[int, float] = {}
+def read_heartbeat_info(heartbeat_dir: str
+                        ) -> Dict[int, Tuple[float, str, float]]:
+    """{rank: (last stamp, span name, span age at stamp)} for every
+    readable heartbeat file. Files in the pre-span single-line format
+    parse as (stamp, "", 0.0). Tolerates concurrent writers and
+    vanishing files (ENOENT races)."""
+    info: Dict[int, Tuple[float, str, float]] = {}
     try:
         names = os.listdir(heartbeat_dir)
     except (FileNotFoundError, NotADirectoryError):
-        return stamps
+        return info
     for name in names:
         if not name.startswith(_HB_PREFIX) or name.endswith(".tmp"):
             continue
         try:
             rank = int(name[len(_HB_PREFIX):])
             with open(os.path.join(heartbeat_dir, name)) as f:
-                stamps[rank] = float(f.read().strip())
-        except (ValueError, OSError):
+                lines = f.read().splitlines()
+            stamp = float(lines[0].strip())
+            span_name, span_age = "", 0.0
+            if len(lines) > 1 and lines[1].strip():
+                age_s, _, span_name = lines[1].strip().partition(" ")
+                span_age = float(age_s)
+            info[rank] = (stamp, span_name, span_age)
+        except (ValueError, OSError, IndexError):
             continue        # torn tmp name / racing unlink: skip
-    return stamps
+    return info
+
+
+def read_heartbeats(heartbeat_dir: str) -> Dict[int, float]:
+    """{rank: last wall-clock stamp} for every readable heartbeat
+    file (the stamp-only view of `read_heartbeat_info`)."""
+    return {r: t[0] for r, t in read_heartbeat_info(heartbeat_dir).items()}
 
 
 class CollectiveGuard:
@@ -142,6 +166,9 @@ class CollectiveGuard:
             self._entered = self._clock()
             self._deadline = self._entered + self.timeout_s * factor
         self.heartbeat_once()
+        _flightrec.record_collective(
+            site, "enter", deadline_s=self.timeout_s * factor,
+            heartbeat_ages=self.heartbeat_ages() or None)
 
     def exit_(self) -> None:
         from ..observability.registry import registry
@@ -149,7 +176,9 @@ class CollectiveGuard:
             entered, site = self._entered, self._site
             self._site = self._deadline = self._entered = None
         if entered is not None:
-            registry.record_collective_guard(self._clock() - entered)
+            wall_s = self._clock() - entered
+            registry.record_collective_guard(wall_s)
+            _flightrec.record_collective(site, "exit", wall_s=wall_s)
 
     @contextmanager
     def guard(self, site: str):
@@ -174,11 +203,24 @@ class CollectiveGuard:
             self.exit_()
 
     # -- liveness -------------------------------------------------------
+    def _span_payload(self) -> Tuple[str, float]:
+        """What this rank is doing right now, for the heartbeat tag:
+        the active collective bracket when one is open (the interesting
+        case for a hang diagnosis), else the innermost open trace span."""
+        with self._lock:
+            site, entered = self._site, self._entered
+        if site is not None and entered is not None:
+            return f"collective:{site}", max(0.0, self._clock() - entered)
+        from ..observability.registry import registry
+        return registry.trace.innermost_open()
+
     def heartbeat_once(self) -> None:
         if self.heartbeat_dir:
+            name, age = self._span_payload()
             try:
                 write_heartbeat(self.heartbeat_dir, self.rank,
-                                self._wall())
+                                self._wall(), span_name=name,
+                                span_age=age)
             except OSError as exc:
                 Log.warning("collective watchdog: heartbeat write "
                             "failed (%s: %s)", type(exc).__name__, exc)
@@ -199,7 +241,9 @@ class CollectiveGuard:
         if not self.heartbeat_dir:
             return head + " (no heartbeat_dir configured; cannot name " \
                           "the stalled rank)"
-        ages = self.heartbeat_ages()
+        now = self._wall()
+        info = read_heartbeat_info(self.heartbeat_dir)
+        ages = {r: max(0.0, now - t[0]) for r, t in info.items()}
         from ..observability.registry import registry
         peers = {r: a for r, a in ages.items() if r != self.rank}
         if peers:
@@ -211,7 +255,11 @@ class CollectiveGuard:
                        if a > stale_after)
         parts = []
         for age, r in reversed(stale):
-            parts.append(f"rank {r} last seen {age:.1f}s ago")
+            part = f"rank {r} last seen {age:.1f}s ago"
+            span_name = info[r][1]
+            if span_name:
+                part += f" in span {span_name}"
+            parts.append(part)
         for r in missing:
             parts.append(f"rank {r} never heartbeat")
         if not parts:
@@ -236,6 +284,8 @@ class CollectiveGuard:
     def _abort(self, diag: str) -> None:
         from ..observability.registry import registry
         registry.record_collective_abort()
+        _flightrec.record("abort", "watchdog", diag=diag[:500],
+                          exit_code=WATCHDOG_EXIT_CODE)
         msg = ("collective watchdog: " + diag +
                f" — aborting this rank (os._exit({WATCHDOG_EXIT_CODE})) "
                f"instead of hanging; resume from the last coordinated "
@@ -243,8 +293,13 @@ class CollectiveGuard:
         Log.warning(msg)
         print(msg, file=sys.stderr, flush=True)
         if self._abort_fn is not None:
+            # stubbed abort (tests): flush only to a configured bundle
+            # directory, never the fatal-path cwd fallback
+            if _flightrec.out_dir:
+                _flightrec.flush("watchdog_abort")
             self._abort_fn(diag)
             return
+        _flightrec.flush("watchdog_abort")
         os._exit(WATCHDOG_EXIT_CODE)
 
     def _heartbeat_loop(self) -> None:

@@ -3,9 +3,12 @@
 Reference: Common::Timer / FunctionTimer RAII profiling accumulators
 (include/LightGBM/utils/common.h:973,1037; printed at exit under USE_TIMETAG)
 plus one process-global registry `global_timer` (src/boosting/gbdt.cpp:20).
-On TPU, device phases additionally want `jax.profiler` traces; this host
-timer brackets whole phases the same way the reference brackets CUDA phases
-(cuda_single_gpu_tree_learner.cpp:112-169).
+This host timer brackets whole phases the same way the reference brackets
+CUDA phases (cuda_single_gpu_tree_learner.cpp:112-169). For the device
+side, `lightgbm_tpu/observability/profile.py` brackets real
+``jax.profiler`` captures around named spans (``profile_spans=`` globs,
+e.g. ``pipeline_block,sharded_grow`` — the BENCH_r06 attribution
+protocol in docs/Performance.md).
 """
 
 from __future__ import annotations

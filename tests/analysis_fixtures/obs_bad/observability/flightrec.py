@@ -1,0 +1,4 @@
+"""Flight-recorder stub (parsed, never executed) — its presence under
+an observability/ dir is the OBS001 gate: this fixture tree models a
+package that HAS the crash flight recorder, so unbracketed manifest
+sites are real findings."""

@@ -1,0 +1,18 @@
+"""Bracketed collective wrapper stripped of its observability bracket
+(parsed, never executed) — OBS001 must flag guarded_allgather."""
+
+
+def check_collective_fault(site):
+    return site
+
+
+def guarded_allgather(arr, label):
+    # fault site present (FAULT001 quiet) but no collective_guard /
+    # span / record_* bracket — OBS001 fires on the def line above
+    check_collective_fault("collective_psum")
+    return arr
+
+
+def checkpoint_agree(value, label):
+    # covered: delegates to the bracketed wrapper
+    return guarded_allgather(value, label)

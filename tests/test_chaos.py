@@ -12,13 +12,16 @@ Slow (three 2-process training runs + one watchdog deadline wait):
 excluded from tier-1 via the `slow` marker; run with `make chaos`.
 """
 
+import json
 import os
 
 import pytest
 
+from lightgbm_tpu.observability.flightrec import POSTMORTEM_PREFIX
 from lightgbm_tpu.reliability.checkpoint import (COMMIT_MARKER,
                                                  latest_checkpoint)
 from lightgbm_tpu.reliability.faults import RANK_DEATH_EXIT_CODE
+from lightgbm_tpu.reliability.watchdog import WATCHDOG_EXIT_CODE
 from lightgbm_tpu.testing.chaos import (run_chaos_training,
                                         strip_rank_local_params)
 
@@ -95,3 +98,53 @@ def test_rank_death_survivor_aborts_and_resume_is_byte_identical(
     # coordinated-checkpoint resume lost nothing but wall-clock
     assert _read_model(resume_dir, 0) == ref_model
     assert _read_model(resume_dir, 1) == ref_model
+
+
+def test_postmortem_bundles(tmp_path):
+    """The flight-recorder acceptance scenario (`make postmortem`):
+    the same 2-rank kill, but the assertion is the forensics — BOTH
+    ranks leave a ``postmortem_<rank>.json`` in the shared checkpoint
+    dir (flightrec_dir defaults to checkpoint_dir), and each bundle's
+    last events name the collective the rank died in."""
+    workdir = str(tmp_path / "chaos")
+    ckpts = os.path.join(workdir, "ckpts")
+    res = {r.rank: r for r in run_chaos_training(
+        workdir, rounds=ROUNDS, ckpt_period=CKPT_PERIOD,
+        ckpt_dir=ckpts, timeout_s=TIMEOUT_S,
+        death_rank=1, death_iter=DEATH_ITER)}
+    dead, survivor = res[1], res[0]
+    assert dead.returncode == RANK_DEATH_EXIT_CODE, dead.tail()
+    assert survivor.returncode == WATCHDOG_EXIT_CODE, survivor.tail()
+
+    bundles = {}
+    for rank in (0, 1):
+        path = os.path.join(ckpts, f"{POSTMORTEM_PREFIX}{rank}.json")
+        assert os.path.isfile(path), (
+            f"rank {rank} left no postmortem bundle in {ckpts}: "
+            f"{sorted(os.listdir(ckpts))}")
+        with open(path) as f:
+            bundles[rank] = json.load(f)
+        assert bundles[rank]["rank"] == rank
+
+    # the killed rank: flushed by the rank_death exit hook, last event
+    # is the fault hit at the collective site it died inside
+    assert bundles[1]["reason"] == "rank_death"
+    last = bundles[1]["events"][-1]
+    assert (last["kind"], last["name"], last["mode"]) == \
+        ("fault", "collective_psum", "rank_death")
+
+    # the survivor: flushed by the watchdog abort, last event carries
+    # the named-culprit diagnostic; the hung bracket (an enter with no
+    # matching exit) names the collective site it was stuck in
+    assert bundles[0]["reason"] == "watchdog_abort"
+    events = bundles[0]["events"]
+    assert events[-1]["kind"] == "abort"
+    assert "rank 1 last seen" in events[-1]["diag"]
+    opens = [e["name"] for e in events if e["kind"] == "collective"
+             and e.get("phase") == "enter"]
+    closes = [e["name"] for e in events if e["kind"] == "collective"
+              and e.get("phase") == "exit"]
+    assert opens, "survivor recorded no collective brackets"
+    hung = opens[len(closes):]
+    assert hung, "survivor's last collective bracket closed cleanly"
+    assert hung[0] in events[-1]["diag"]

@@ -1,0 +1,149 @@
+"""Bench regression sentinel (observability/regress.py + bench.py
+--compare) — tier-1. Two halves: (1) the REAL trajectory in the repo
+root must schema-validate and carry no regressions (the contract that
+makes the sentinel a guard for every later round); (2) synthetic
+trajectories prove the detectors fire: a >10% drop, a broken latest
+record, a multichip flip, and the --strict exit code."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from lightgbm_tpu.observability import regress
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _write(dirpath, name, rec):
+    with open(os.path.join(str(dirpath), name), "w") as fh:
+        json.dump(rec, fh)
+
+
+def _bench_rec(value, rc=0, metric="higgs1m_trees_per_sec", **extra):
+    parsed = None if value is None else {
+        "metric": metric, "unit": "trees/s", "value": value, **extra}
+    return {"n": 1, "cmd": "python bench.py", "rc": rc, "tail": "",
+            "parsed": parsed}
+
+
+# ---------------------------------------------------------------------------
+# the real trajectory is a checked artifact
+
+def test_real_trajectory_schema_validates():
+    traj = regress.load_trajectory(REPO)
+    assert traj["bench"], "no BENCH_r*.json in the repo root"
+    problems = []
+    for kind in ("bench", "multichip"):
+        for _, name, rec in traj[kind]:
+            problems += regress.validate_record(kind, name, rec)
+    assert not problems, "\n".join(problems)
+
+
+def test_real_trajectory_has_no_regressions():
+    result = regress.compare()
+    assert result["root"] == REPO
+    assert result["regressions"] == [], regress.render_compare(result)
+    # the headline metric is tracked with best-so-far context
+    assert "higgs1m_trees_per_sec" in result["metrics"]
+
+
+# ---------------------------------------------------------------------------
+# detectors, on synthetic trajectories
+
+def test_drop_beyond_threshold_is_flagged(tmp_path):
+    _write(tmp_path, "BENCH_r01.json", _bench_rec(2.0))
+    _write(tmp_path, "BENCH_r02.json", _bench_rec(3.0))
+    _write(tmp_path, "BENCH_r03.json", _bench_rec(2.5))   # -16.7% vs 3.0
+    result = regress.compare(str(tmp_path))
+    (reg,) = result["regressions"]
+    assert reg["metric"] == "higgs1m_trees_per_sec"
+    assert reg["best"] == 3.0 and reg["best_round"] == 2
+    assert reg["drop_frac"] == pytest.approx(1 - 2.5 / 3.0, abs=1e-4)
+    assert "REGRESSION" in regress.render_compare(result)
+
+
+def test_drop_within_threshold_is_quiet(tmp_path):
+    _write(tmp_path, "BENCH_r01.json", _bench_rec(3.0))
+    _write(tmp_path, "BENCH_r02.json", _bench_rec(2.75))  # -8.3%: ok
+    result = regress.compare(str(tmp_path))
+    assert result["regressions"] == []
+    assert result["metrics"]["higgs1m_trees_per_sec"]["delta_frac"] < 0
+
+
+def test_ratio_side_channels_tracked(tmp_path):
+    _write(tmp_path, "BENCH_r01.json", _bench_rec(2.0, vs_baseline=0.8))
+    _write(tmp_path, "BENCH_r02.json", _bench_rec(2.1, vs_baseline=0.5))
+    result = regress.compare(str(tmp_path))
+    (reg,) = result["regressions"]
+    assert reg["metric"] == "higgs1m_trees_per_sec:vs_baseline"
+
+
+def test_unusable_rounds_excluded_from_best(tmp_path):
+    # an rc!=0 round and a value<=0 round never become the best bar
+    _write(tmp_path, "BENCH_r01.json", _bench_rec(2.0))
+    _write(tmp_path, "BENCH_r02.json", _bench_rec(99.0, rc=1))
+    _write(tmp_path, "BENCH_r03.json", _bench_rec(0.0))
+    _write(tmp_path, "BENCH_r04.json", _bench_rec(2.1))
+    result = regress.compare(str(tmp_path))
+    assert result["regressions"] == []
+    entry = result["metrics"]["higgs1m_trees_per_sec"]
+    assert entry["best"] == 2.0 and entry["samples"] == 2
+
+
+def test_broken_latest_record_is_a_regression(tmp_path):
+    _write(tmp_path, "BENCH_r01.json", _bench_rec(2.0))
+    _write(tmp_path, "BENCH_r02.json", _bench_rec(None, rc=3))
+    result = regress.compare(str(tmp_path))
+    (reg,) = result["regressions"]
+    assert reg["metric"] == "bench_record"
+    assert reg["record"] == "BENCH_r02.json"
+
+
+def test_multichip_flip_is_a_regression(tmp_path):
+    mc = {"n_devices": 2, "rc": 0, "ok": True, "skipped": False}
+    _write(tmp_path, "MULTICHIP_r01.json", mc)
+    _write(tmp_path, "MULTICHIP_r02.json",
+           {**mc, "rc": 1, "ok": False})
+    result = regress.compare(str(tmp_path))
+    (reg,) = result["regressions"]
+    assert reg["metric"] == "multichip_ok"
+    # skipped rounds are not samples
+    _write(tmp_path, "MULTICHIP_r03.json", {**mc, "skipped": True})
+    assert regress.compare(str(tmp_path))["metrics"]["multichip_ok"][
+        "samples"] == 2
+
+
+# ---------------------------------------------------------------------------
+# bench.py --compare wiring (subprocess: the real CLI path)
+
+def _run_compare(*argv):
+    return subprocess.run(
+        [sys.executable, BENCH, "--compare", *argv],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=120)
+
+
+def test_bench_compare_real_trajectory_passes():
+    proc = _run_compare("--strict")
+    assert proc.returncode == 0, proc.stderr
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert payload["bench_regressions"]["regressions"] == []
+    assert "no regressions" in proc.stderr
+
+
+def test_bench_compare_strict_fails_on_regression(tmp_path):
+    _write(tmp_path, "BENCH_r01.json", _bench_rec(3.0))
+    _write(tmp_path, "BENCH_r02.json", _bench_rec(1.0))
+    proc = _run_compare("--strict", "--trajectory-dir", str(tmp_path))
+    assert proc.returncode == 1
+    assert "REGRESSION" in proc.stderr
+    # without --strict the same trajectory reports but exits 0
+    proc = _run_compare("--trajectory-dir", str(tmp_path))
+    assert proc.returncode == 0
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert payload["bench_regressions"]["regressions"]

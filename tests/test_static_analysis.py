@@ -27,8 +27,8 @@ PACKAGE_DIR = os.path.dirname(os.path.abspath(lightgbm_tpu.__file__))
 ALL_RULE_IDS = (
     "COLL001", "COLL002", "COLL003", "COLL004",
     "DTYPE001", "DTYPE002", "FAULT001", "JIT001", "JIT002", "JIT003",
-    "JIT004", "LOCK001", "LOCK002", "PALLAS001", "REG001", "REG002",
-    "REG003", "REG004", "REG005", "SUP001",
+    "JIT004", "LOCK001", "LOCK002", "OBS001", "PALLAS001", "REG001",
+    "REG002", "REG003", "REG004", "REG005", "SUP001",
 )
 
 
@@ -168,6 +168,22 @@ def test_fault_coverage_rule_fires():
     assert sites == {"fused_dispatch", "histogram_build",
                      "collective_psum"}
     assert len(findings) == 3
+
+
+def test_observability_bracket_rule_fires():
+    # guarded_allgather carries its fault site (FAULT001 quiet) but no
+    # span/guard/record_* bracket; checkpoint_agree is covered by
+    # delegating to the bracketed wrapper
+    findings = run_on("obs_bad")
+    assert hits(findings) == {("OBS001", 9)}
+    (finding,) = findings
+    assert "guarded_allgather" in finding.message
+
+
+def test_observability_rule_gated_on_flightrec():
+    # fixture trees without observability/flightrec.py model packages
+    # that predate the flight recorder — OBS001 stays silent there
+    assert not [f for f in run_on("fault_bad") if f.rule == "OBS001"]
 
 
 # ----------------------------------------------------------------------

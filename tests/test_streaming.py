@@ -368,9 +368,12 @@ class TestMapperSync:
         from lightgbm_tpu.config import Config
         gathered = []
 
-        def fake_allgather(x):
-            gathered.append(np.asarray(x))
-            return np.asarray(x)[None]
+        def fake_allgather(tree):
+            # guarded_allgather ships (payload, wall-clock stamp): the
+            # real process_allgather maps over the pytree
+            arr, wall = tree
+            gathered.append(np.asarray(arr))
+            return np.asarray(arr)[None], np.asarray(wall)[None]
 
         monkeypatch.setattr(multihost_utils, "process_allgather",
                             fake_allgather)
@@ -388,8 +391,9 @@ class TestMapperSync:
         from lightgbm_tpu.config import Config
         X, _ = make_binary(n=300, f=4, seed=3)
         Xd = np.asarray(X, np.float64)
-        monkeypatch.setattr(multihost_utils, "process_allgather",
-                            lambda x: np.asarray(x)[None])
+        monkeypatch.setattr(
+            multihost_utils, "process_allgather",
+            lambda tree: tuple(np.asarray(x)[None] for x in tree))
         cfg = Config({"bin_construct_sample_cnt": 300})
         got = basic._allgather_find_mappers(Xd, cfg, None)
         ref = find_bin_mappers(

@@ -31,6 +31,7 @@ from lightgbm_tpu.reliability.watchdog import (CollectiveGuard,
                                                collective_guard,
                                                configure_watchdog,
                                                maybe_start_watchdog,
+                                               read_heartbeat_info,
                                                read_heartbeats,
                                                shutdown_watchdog,
                                                write_heartbeat)
@@ -97,6 +98,39 @@ def test_heartbeat_roundtrip_and_missing_dir(tmp_path):
     write_heartbeat(hb, 1, 99.0)
     assert read_heartbeats(hb) == {0: 123.5, 1: 99.0}
     assert read_heartbeats(str(tmp_path / "nope")) == {}
+
+
+def test_heartbeat_span_payload_roundtrip(tmp_path):
+    hb = str(tmp_path / "hb")
+    write_heartbeat(hb, 0, 123.5, span_name="collective:sharded_grow",
+                    span_age=12.25)
+    write_heartbeat(hb, 1, 99.0)                   # no open span
+    info = read_heartbeat_info(hb)
+    assert info[0] == (123.5, "collective:sharded_grow", 12.25)
+    assert info[1] == (99.0, "", 0.0)
+    # the stamp-only view is unchanged by the span tag
+    assert read_heartbeats(hb) == {0: 123.5, 1: 99.0}
+
+
+def test_heartbeat_old_single_line_format_parses(tmp_path):
+    # files written by a pre-span-payload build: one line, repr(float)
+    hb = tmp_path / "hb"
+    hb.mkdir()
+    (hb / "hb_rank_002").write_text(repr(456.75))
+    assert read_heartbeat_info(str(hb)) == {2: (456.75, "", 0.0)}
+
+
+def test_diagnosis_names_stale_ranks_span(tmp_path):
+    hb = str(tmp_path / "hb")
+    wall = FakeClock(500.0)
+    g = CollectiveGuard(1.0, rank=0, world=2, heartbeat_dir=hb,
+                        heartbeat_interval_s=1.0, wall=wall)
+    write_heartbeat(hb, 0, 500.0)
+    write_heartbeat(hb, 1, 488.0,
+                    span_name="collective:sharded_grow", span_age=3.0)
+    diag = g.diagnose("sharded_grow")
+    assert ("rank 1 last seen 12.0s ago in span "
+            "collective:sharded_grow") in diag
 
 
 def test_stale_heartbeat_diagnosis_names_right_rank(tmp_path):
