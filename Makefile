@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-all faults chaos postmortem observe lint lint-sarif pipeline kernels stream bench install
+.PHONY: test test-slow test-all faults chaos postmortem observe lint lint-sarif pipeline kernels stream bench serve-chaos serve-bench install
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -65,6 +65,23 @@ chaos:
 # docs/Observability.md "Post-mortem workflow")
 postmortem:
 	$(PY) -m pytest tests/test_chaos.py -x -q -m chaos -k postmortem
+
+# the serving chaos tier: concurrent load while the fault registry
+# kills replica dispatches, breakers trip/heal, and the model is
+# hot-swapped mid-run — zero drops, bit-identical answers, breaker
+# lifecycle visible in metrics (tests/test_serve_chaos.py,
+# docs/Serving.md "Degradation ladder") — fast subset is tier-1; the
+# second invocation adds the slow open-loop QPS ramp
+serve-chaos:
+	$(PY) -m pytest tests/test_serve_chaos.py -x -q -m "serve_chaos and not slow"
+	$(PY) -m pytest tests/test_serve_chaos.py -x -q -m "serve_chaos and slow"
+
+# the serving load bench: open-loop QPS ramp + chaos stage, emits
+# SERVE_r<N>.json (sustained QPS at p99<10ms) into the same
+# regression-sentinel trajectory as BENCH_r*
+serve-bench:
+	$(PY) bench_serve.py
+	$(PY) bench.py --compare --strict
 
 # the observability tier: spans, training telemetry, MFU accounting,
 # Prometheus /metrics (tests/test_observability.py, docs/Observability.md)

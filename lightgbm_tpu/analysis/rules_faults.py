@@ -31,6 +31,8 @@ DISPATCH_MANIFEST = (
     ("gbdt.py", "_grow", "histogram_build"),
     ("gbdt.py", "_grow", "collective_psum"),
     ("engine.py", "predict_raw", "serving_device_predict"),
+    ("replicas.py", "dispatch", "serving_replica_predict"),
+    ("server.py", "hot_swap", "serving_hot_swap"),
     ("checkpoint.py", "save_checkpoint", "checkpoint_io"),
     ("loader.py", "_ingest_chunk_step", "streaming_ingest"),
     ("comm.py", "guarded_allgather", "collective_psum"),
@@ -48,6 +50,8 @@ SITE_WRAPPERS = {
 #: exists at top level and in serving/) — constrain by parent dir
 _DIR_HINTS = {
     ("engine.py", "predict_raw"): "serving",
+    ("replicas.py", "dispatch"): "serving",
+    ("server.py", "hot_swap"): "serving",
     ("checkpoint.py", "save_checkpoint"): "reliability",
     ("gbdt.py", "train_many_dispatch"): "boosting",
     ("gbdt.py", "_grow"): "boosting",

@@ -198,6 +198,31 @@ _PARAMS: List[ParamSpec] = [
     _p("serve_max_bucket", int, 1024, ("max_bucket",), lambda v: v > 0),
     _p("serve_max_models", int, 8, (), lambda v: v > 0),
     _p("serve_metrics_file", str, "", ("metrics_file",)),
+    _p("serve_slo_ms", float, 0.0, ("slo_ms", "serve_deadline_ms"),
+       lambda v: v >= 0,
+       desc="per-request SLO budget in milliseconds: the micro-batcher "
+            "sheds a request at admission when its projected queue wait "
+            "exceeds the remaining budget, and expires requests still "
+            "queued past their deadline. 0 (default) disables deadlines"),
+    _p("serve_deadline_policy", str, "fallback", ("deadline_policy",),
+       lambda v: v in ("fallback", "fail"),
+       desc="what a deadline-missed request gets: 'fallback' (default) "
+            "answers it via host predict and counts a deadline miss; "
+            "'fail' raises DeadlineExceeded to the caller fast"),
+    _p("serve_replicas", int, 1, ("num_replicas",), lambda v: v >= 0,
+       desc="device replicas per served model, with least-loaded "
+            "routing gated on per-replica circuit breakers; 0 means one "
+            "replica per local device"),
+    _p("serve_breaker_threshold", int, 3, ("breaker_threshold",),
+       lambda v: v >= 1,
+       desc="consecutive device-dispatch failures that open a "
+            "replica's circuit breaker (traffic fails over until the "
+            "cooldown's half-open probe closes it again)"),
+    _p("serve_breaker_cooldown_ms", float, 250.0, ("breaker_cooldown_ms",),
+       lambda v: v >= 0,
+       desc="how long an open breaker refuses dispatches before "
+            "granting one half-open probe; a clean probe re-closes the "
+            "breaker (self-healing)"),
     # ---- Observability (lightgbm_tpu/observability/,
     #      docs/Observability.md) ----
     _p("observe", bool, False, ("observability",),

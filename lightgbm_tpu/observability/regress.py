@@ -1,9 +1,11 @@
 """Bench regression sentinel: the perf trajectory as a checked artifact.
 
 Every round leaves a ``BENCH_r<N>.json`` (wrapped single-line bench
-record: {"n", "cmd", "rc", "tail", "parsed": {metric record}}) and a
+record: {"n", "cmd", "rc", "tail", "parsed": {metric record}}), a
 ``MULTICHIP_r<N>.json`` ({"n_devices", "rc", "ok", "skipped", "tail"})
-in the repo root. Nothing ever read them back — a silent perf
+and — since the serving chaos PR — a ``SERVE_r<N>.json``
+(bench-record shape, emitted by bench_serve.py: sustained QPS at
+p99<10ms plus shed/fallback/failover side channels) in the repo root. Nothing ever read them back — a silent perf
 regression would ride along unnoticed until someone eyeballed the
 numbers. This module parses the whole trajectory, computes per-metric
 best-so-far, and flags the latest round when it drops more than
@@ -52,9 +54,11 @@ def _load_series(root: str, pattern: str) -> List[Tuple[int, str, Dict]]:
 
 
 def load_trajectory(root: str) -> Dict[str, List[Tuple[int, str, Dict]]]:
-    """{"bench": [...], "multichip": [...]} round-ordered records."""
+    """{"bench": [...], "multichip": [...], "serve": [...]}
+    round-ordered records."""
     return {"bench": _load_series(root, "BENCH_r*.json"),
-            "multichip": _load_series(root, "MULTICHIP_r*.json")}
+            "multichip": _load_series(root, "MULTICHIP_r*.json"),
+            "serve": _load_series(root, "SERVE_r*.json")}
 
 
 def validate_record(kind: str, name: str, rec) -> List[str]:
@@ -70,7 +74,9 @@ def validate_record(kind: str, name: str, rec) -> List[str]:
 
     if not isinstance(rec, dict):
         return [f"{name}: record is {type(rec).__name__}, not an object"]
-    if kind == "bench":
+    if kind in ("bench", "serve"):
+        # SERVE_r*.json (bench_serve.py) uses the bench record shape,
+        # so serving rides the same sentinel machinery as training
         _need("n", int)
         _need("rc", int)
         _need("cmd", str)
@@ -131,7 +137,10 @@ def compare(root: Optional[str] = None,
     metrics: Dict[str, Dict] = {}
     regressions: List[Dict] = []
 
-    for metric, points in sorted(_bench_points(traj["bench"]).items()):
+    all_points = dict(_bench_points(traj["bench"]))
+    for metric, pts in _bench_points(traj["serve"]).items():
+        all_points[f"serve:{metric}"] = pts
+    for metric, points in sorted(all_points.items()):
         latest_rnd, latest = points[-1]
         earlier = points[:-1]
         entry: Dict = {"latest": latest, "latest_round": latest_rnd,
@@ -148,20 +157,21 @@ def compare(root: Optional[str] = None,
                     "drop_frac": round(1.0 - latest / best, 4)})
         metrics[metric] = entry
 
-    # an unusable latest bench round after any usable one: the bench
-    # itself regressed, whatever the numbers used to say
-    bench = traj["bench"]
-    if bench and _bench_points(bench):
-        last_rnd, last_name, last = bench[-1]
-        usable_rounds = {r for pts in _bench_points(bench).values()
-                         for r, _ in pts}
-        if last_rnd not in usable_rounds:
-            regressions.append({
-                "metric": "bench_record", "latest_round": last_rnd,
-                "record": last_name,
-                "drop_frac": 1.0,
-                "detail": f"rc={last.get('rc')!r} "
-                          f"parsed={last.get('parsed')!r}"})
+    # an unusable latest bench/serve round after any usable one: the
+    # bench itself regressed, whatever the numbers used to say
+    for series_name, series in (("bench_record", traj["bench"]),
+                                ("serve_record", traj["serve"])):
+        if series and _bench_points(series):
+            last_rnd, last_name, last = series[-1]
+            usable_rounds = {r for pts in _bench_points(series).values()
+                             for r, _ in pts}
+            if last_rnd not in usable_rounds:
+                regressions.append({
+                    "metric": series_name, "latest_round": last_rnd,
+                    "record": last_name,
+                    "drop_frac": 1.0,
+                    "detail": f"rc={last.get('rc')!r} "
+                              f"parsed={last.get('parsed')!r}"})
 
     mc = [(rnd, rec) for rnd, _, rec in traj["multichip"]
           if not rec.get("skipped", False)]
@@ -180,13 +190,15 @@ def compare(root: Optional[str] = None,
     return {"root": root, "threshold": threshold,
             "bench_records": len(traj["bench"]),
             "multichip_records": len(traj["multichip"]),
+            "serve_records": len(traj["serve"]),
             "metrics": metrics, "regressions": regressions}
 
 
 def render_compare(result: Dict) -> str:
     """Human tail for ``bench.py --compare`` (stderr)."""
     lines = [f"bench trajectory: {result['bench_records']} bench + "
-             f"{result['multichip_records']} multichip records "
+             f"{result['multichip_records']} multichip + "
+             f"{result.get('serve_records', 0)} serve records "
              f"(threshold {result['threshold']:.0%})"]
     for metric, e in sorted(result["metrics"].items()):
         if "best" in e:

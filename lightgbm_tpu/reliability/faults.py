@@ -20,6 +20,9 @@ site                        raised from
 ``histogram_build``         GBDT tree growth dispatch (histogram + split path)
 ``collective_psum``         parallel dispatch boundary before sharded growth
 ``serving_device_predict``  serving BucketedPredictor.predict_raw
+``serving_replica_predict`` serving ReplicaSet.dispatch, per-replica device
+                            attempt (drives breaker open/failover)
+``serving_hot_swap``        serving Server.hot_swap, before the registry swap
 ``checkpoint_io``           reliability.checkpoint bundle writes
 ``streaming_ingest``        streaming.loader per-chunk ingest step (both
                             passes), before sketch/bin work on the chunk
@@ -61,6 +64,8 @@ KNOWN_SITES = (
     "histogram_build",
     "collective_psum",
     "serving_device_predict",
+    "serving_replica_predict",
+    "serving_hot_swap",
     "checkpoint_io",
     "streaming_ingest",
 )

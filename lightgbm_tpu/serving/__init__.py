@@ -1,21 +1,29 @@
 """TPU-resident inference serving engine.
 
 Loads a trained (or file-loaded) Booster once into stacked device
-arrays and serves request streams through a shape-bucketed compiled
-predictor with micro-batching, admission control, host fallback, and a
+arrays, replicates it across local devices, and serves request streams
+through a shape-bucketed compiled predictor with micro-batching,
+SLO-budgeted admission control, per-replica self-healing circuit
+breakers, failover, zero-downtime hot-swap, host fallback, and a
 per-model metrics surface. See docs/Serving.md and `Server`.
 """
 
-from .batcher import MicroBatcher, OverloadError
+from .batcher import (BatcherClosed, DeadlineExceeded, MicroBatcher,
+                      OverloadError)
+from .breaker import BREAKER_STATES, CircuitBreaker, breaker_state_code
 from .engine import BucketedPredictor, max_compilations, next_bucket
 from .forest import DeviceForest, FeatureBinner, build_device_forest
 from .metrics import ModelMetrics
 from .registry import ModelEntry, ModelRegistry
+from .replicas import NoReplicaAvailable, Replica, ReplicaSet
 from .server import Server
 
 __all__ = [
     "Server", "ModelRegistry", "ModelEntry", "ModelMetrics",
-    "MicroBatcher", "OverloadError", "BucketedPredictor",
+    "MicroBatcher", "OverloadError", "BatcherClosed",
+    "DeadlineExceeded", "CircuitBreaker", "BREAKER_STATES",
+    "breaker_state_code", "Replica", "ReplicaSet",
+    "NoReplicaAvailable", "BucketedPredictor",
     "DeviceForest", "FeatureBinner", "build_device_forest",
     "next_bucket", "max_compilations",
 ]

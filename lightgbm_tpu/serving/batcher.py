@@ -13,6 +13,16 @@ Admission control: once `max_queue` requests are waiting, new arrivals
 are shed immediately with `OverloadError` instead of growing the queue
 without bound — a bounded queue keeps tail latency bounded too.
 
+SLO budgets (the top rung of the degradation ladder, docs/Serving.md):
+a request may carry a *deadline*. At submit the batcher projects the
+queue wait from an EMA of recent batch service times — if the
+projection already overshoots the remaining budget the request is shed
+NOW with `DeadlineExceeded`, while the caller can still answer it
+cheaply (host predict), instead of letting it queue, expire, and waste
+a device slot. Requests that expire anyway (service time spiked after
+admission) are expired at dispatch time, again with
+`DeadlineExceeded`, never silently dropped.
+
 `pause()`/`resume()` freeze the worker between batches; tests use this
 to enqueue a deterministic set of requests and observe exactly one
 coalesced device batch.
@@ -29,11 +39,22 @@ import numpy as np
 
 from ..utils.log import Log
 
-__all__ = ["MicroBatcher", "OverloadError", "BatcherClosed"]
+__all__ = ["MicroBatcher", "OverloadError", "BatcherClosed",
+           "DeadlineExceeded"]
 
 
 class OverloadError(RuntimeError):
     """Request shed by admission control (queue depth exceeded)."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """Request's SLO budget cannot be met by the device queue.
+
+    Raised at submit when the projected queue wait overshoots the
+    remaining budget, or set on the future when a queued request
+    expires before dispatch. The server's deadline policy decides what
+    the caller sees: ``fallback`` answers via host predict, ``fail``
+    propagates this error."""
 
 
 class BatcherClosed(RuntimeError):
@@ -46,12 +67,14 @@ class BatcherClosed(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("bins", "future", "t_enqueue")
+    __slots__ = ("bins", "future", "t_enqueue", "deadline")
 
-    def __init__(self, bins: np.ndarray):
+    def __init__(self, bins: np.ndarray,
+                 deadline: Optional[float] = None):
         self.bins = bins
         self.future: Future = Future()
         self.t_enqueue = time.monotonic()
+        self.deadline = deadline      # absolute monotonic, or None
 
 
 class MicroBatcher:
@@ -77,27 +100,58 @@ class MicroBatcher:
         self._paused = False
         self._closed = False
         self.shed_count = 0
+        self.deadline_shed_count = 0   # budget-projection sheds at submit
+        self.deadline_expired_count = 0  # expired while queued
         self.batch_count = 0
         self.coalesced_requests = 0
+        # EMA of device batch service time, seeds the queue-wait
+        # projection before the first batch completes
+        self._ema_batch_s = max(self.max_wait_ms, 1.0) / 1e3
         self._worker = threading.Thread(
             target=self._loop, name=f"serve-batcher-{name}", daemon=True)
         self._worker.start()
 
     # ------------------------------------------------------------------
-    def submit(self, bins: np.ndarray) -> Future:
-        """Queue one request's binned rows; resolves to its raw scores."""
-        req = _Request(bins)
+    def submit(self, bins: np.ndarray,
+               deadline: Optional[float] = None) -> Future:
+        """Queue one request's binned rows; resolves to its raw scores.
+
+        `deadline` is an absolute `time.monotonic()` instant. When the
+        projected queue wait (queued batches ahead × EMA service time
+        + the coalescing window) would already blow the budget, the
+        request is shed here with `DeadlineExceeded` so the caller can
+        still answer it on time via the host path."""
+        req = _Request(bins, deadline)
         with self._lock:
             if self._closed:
-                raise RuntimeError("batcher is closed")
+                raise BatcherClosed(
+                    f"batcher '{self.name}' is closed")
             if len(self._queue) >= self.max_queue:
                 self.shed_count += 1
                 raise OverloadError(
                     f"serving queue for '{self.name}' is full "
                     f"({self.max_queue} requests waiting)")
+            if deadline is not None:
+                wait_s = self._projected_wait_locked(len(bins))
+                if req.t_enqueue + wait_s > deadline:
+                    self.deadline_shed_count += 1
+                    raise DeadlineExceeded(
+                        f"serving queue for '{self.name}': projected "
+                        f"wait {wait_s * 1e3:.1f}ms exceeds remaining "
+                        f"budget "
+                        f"{(deadline - req.t_enqueue) * 1e3:.1f}ms")
             self._queue.append(req)
             self._wake.notify()
         return req.future
+
+    def _projected_wait_locked(self, incoming_rows: int) -> float:
+        """Estimated seconds before a request submitted now gets its
+        result: device batches ahead of it × EMA service time, plus the
+        coalescing window it may itself sit out. Caller holds _lock."""
+        rows = sum(len(r.bins) for r in self._queue) + int(incoming_rows)
+        batches_ahead = max(
+            (rows + self.max_batch_size - 1) // self.max_batch_size, 1)
+        return batches_ahead * self._ema_batch_s + self.max_wait_ms / 1e3
 
     def pause(self) -> None:
         """Freeze the worker between batches (deterministic tests)."""
@@ -113,17 +167,41 @@ class MicroBatcher:
         with self._lock:
             return len(self._queue)
 
-    def close(self, timeout: float = 5.0) -> None:
-        with self._lock:
-            self._closed = True
-            self._paused = False
-            self._wake.notify()
+    def close(self, timeout: float = 5.0,
+              drain_queued: bool = True) -> int:
+        """Shut the worker down; returns how many queued requests were
+        resolved with `BatcherClosed` (the hot-swap `swap_drains`
+        accounting).
+
+        ``drain_queued=True`` (plain shutdown) lets the worker dispatch
+        whatever is already queued before exiting. ``drain_queued=False``
+        (hot-swap) pops the queue immediately so no queued request runs
+        against the outgoing forest — each future gets `BatcherClosed`
+        and the server re-answers it through the host path of the OLD
+        entry (same binning, no torn model)."""
+        if drain_queued:
+            with self._lock:
+                self._closed = True
+                self._paused = False
+                self._wake.notify()
+        else:
+            with self._lock:
+                pulled, self._queue = self._queue, []
+                self._closed = True
+                self._paused = False
+                self._wake.notify()
+            for req in pulled:
+                if not req.future.done():
+                    req.future.set_exception(BatcherClosed(
+                        f"batcher '{self.name}' closed before "
+                        f"dispatching this request"))
         self._worker.join(timeout=timeout)
-        # the worker drains the queue on close (the take condition
-        # includes _closed), so leftovers only exist when the join
-        # timed out — a wedged device dispatch. Resolve them with
-        # BatcherClosed so upstream can re-route each request through
-        # the host fallback instead of hanging or dropping its caller.
+        # with drain_queued=True the worker drains the queue on close
+        # (the take condition includes _closed), so leftovers only
+        # exist when the join timed out — a wedged device dispatch.
+        # Resolve them with BatcherClosed so upstream can re-route each
+        # request through the host fallback instead of hanging or
+        # dropping its caller.
         with self._lock:
             leftovers, self._queue = self._queue, []
         for req in leftovers:
@@ -131,6 +209,10 @@ class MicroBatcher:
                 req.future.set_exception(BatcherClosed(
                     f"batcher '{self.name}' closed before dispatching "
                     f"this request"))
+        drained = len(leftovers)
+        if not drain_queued:
+            drained += len(pulled)
+        return drained
 
     # ------------------------------------------------------------------
     def _take_batch(self) -> Optional[List[_Request]]:
@@ -163,13 +245,61 @@ class MicroBatcher:
                     continue
                 self._wake.wait(timeout=0.1)
 
+    def _expire_overdue(self, batch: List[_Request]) -> List[_Request]:
+        """Resolve requests whose deadline already passed (admission's
+        projection was optimistic) with `DeadlineExceeded`; the rest
+        dispatch. Never silently drops a future."""
+        now = time.monotonic()
+        live: List[_Request] = []
+        expired = 0
+        for req in batch:
+            if req.deadline is not None and now > req.deadline:
+                expired += 1
+                if not req.future.done():
+                    req.future.set_exception(DeadlineExceeded(
+                        f"request expired in '{self.name}' queue "
+                        f"({(now - req.t_enqueue) * 1e3:.1f}ms waited)"))
+            else:
+                live.append(req)
+        if expired:
+            with self._lock:
+                self.deadline_expired_count += expired
+        return live
+
     def _loop(self) -> None:
+        try:
+            self._loop_inner()
+        except BaseException as exc:
+            # worker death is a serving fatal: every queued caller
+            # would hang. Post-mortem it, then resolve everything with
+            # BatcherClosed so upstream host-drains each request.
+            from ..observability.flightrec import recorder
+            recorder.record_exception(
+                f"serving_batcher_worker[{self.name}]", exc)
+            recorder.flush("exception")
+            Log.warning(f"serving batcher worker for '{self.name}' "
+                        f"died: {exc}")
+            with self._lock:
+                self._closed = True
+                leftovers, self._queue = self._queue, []
+            for req in leftovers:
+                if not req.future.done():
+                    req.future.set_exception(BatcherClosed(
+                        f"batcher '{self.name}' worker died before "
+                        f"dispatching this request"))
+            raise
+
+    def _loop_inner(self) -> None:
         while True:
             batch = self._take_batch()
             if batch is None:
                 return
+            batch = self._expire_overdue(batch)
+            if not batch:
+                continue
             self.batch_count += 1
             self.coalesced_requests += len(batch)
+            t0 = time.monotonic()
             try:
                 bins = batch[0].bins if len(batch) == 1 else \
                     np.concatenate([r.bins for r in batch], axis=0)
@@ -185,3 +315,18 @@ class MicroBatcher:
                 for req in batch:
                     if not req.future.done():
                         req.future.set_exception(exc)
+            except BaseException:
+                # worker is dying (KeyboardInterrupt/SystemExit): this
+                # batch was already popped from the queue, so resolve
+                # its futures here before _loop's post-mortem handler
+                # deals with the rest of the queue
+                for req in batch:
+                    if not req.future.done():
+                        req.future.set_exception(BatcherClosed(
+                            f"batcher '{self.name}' worker died while "
+                            f"dispatching this request"))
+                raise
+            finally:
+                dt = time.monotonic() - t0
+                with self._lock:
+                    self._ema_batch_s += 0.3 * (dt - self._ema_batch_s)

@@ -40,6 +40,9 @@ class ModelMetrics:
         self.errors = 0
         self.device_retries = 0  # device dispatches that needed a retry
         self.guard_trips = 0     # non-finite device outputs caught
+        self.deadline_misses = 0  # SLO budget sheds + queue expiries
+        self.failovers = 0       # batches re-routed to another replica
+        self.swap_drains = 0     # requests host-drained by a hot-swap
         self._started = time.monotonic()
         self._first_request: Optional[float] = None
         self._last_request: Optional[float] = None
@@ -83,6 +86,18 @@ class ModelMetrics:
         with self._lock:
             self.guard_trips += 1
 
+    def record_deadline_miss(self) -> None:
+        with self._lock:
+            self.deadline_misses += 1
+
+    def record_failover(self) -> None:
+        with self._lock:
+            self.failovers += 1
+
+    def record_swap_drain(self, n: int = 1) -> None:
+        with self._lock:
+            self.swap_drains += int(n)
+
     # ------------------------------------------------------------------
     def snapshot(self) -> Dict:
         with self._lock:
@@ -109,6 +124,9 @@ class ModelMetrics:
                 "fallbacks": self.fallback_count,
                 "device_retries": self.device_retries,
                 "guard_trips": self.guard_trips,
+                "deadline_misses": self.deadline_misses,
+                "failovers": self.failovers,
+                "swap_drains": self.swap_drains,
                 "errors": self.errors,
                 "uptime_sec": round(time.monotonic() - self._started, 3),
             }

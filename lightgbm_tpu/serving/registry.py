@@ -11,6 +11,18 @@ arrays or re-parses a model file. Lifecycle is explicit:
 - `evict(name)`       drop the entry; device memory frees with the
   last array reference
 
+An entry owns everything a request needs — forest, replica set, micro
+batcher — so the server fetches ONE reference and serves the request
+against a consistent snapshot: a refresh can never pair the new forest
+with the old queue (no torn model). The registry builds the entry
+fully (replicas placed, batcher worker running) *before* publishing
+it, then hands the previous entry back to the caller, which drains the
+old batcher outside the lock.
+
+Health is derived, not sticky: `entry.degraded` is computed from the
+replica breakers (`serving/breaker.py`) and heals itself when a probe
+dispatch closes a breaker — the PR-1 manual-refresh flag is gone.
+
 Capacity is bounded: loading past `max_models` evicts the least
 recently *used* entry (use = a `get`), mirroring the bucket cache's
 "bounded resources, predictable behavior" contract.
@@ -21,11 +33,12 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..utils.log import Log, LightGBMError
 from .forest import DeviceForest, build_device_forest
 from .metrics import ModelMetrics
+from .replicas import ReplicaSet
 
 __all__ = ["ModelRegistry", "ModelEntry"]
 
@@ -39,9 +52,25 @@ class ModelEntry:
     loaded_at: float
     version: int = 1
     last_used: float = field(default=0.0)
-    # set by the server after a device failure: subsequent requests for
-    # this entry take the host path until the model is refreshed
-    degraded: bool = False
+    # device-side replica fleet (empty for unsupported forests); the
+    # breakers inside it carry this entry's health
+    replicas: Optional[ReplicaSet] = None
+    # micro-batching queue bound to THIS entry's forest+replicas; the
+    # server submits to entry.batcher so a refresh can never route old
+    # queued bins to a new forest
+    batcher: object = None
+
+    @property
+    def degraded(self) -> bool:
+        """Device path unavailable right now. Derived from breaker
+        state — heals itself when a replica's half-open probe closes
+        its breaker (contrast PR 1's sticky flag, cleared only by a
+        manual refresh)."""
+        if not self.forest.supported:
+            return True
+        if self.replicas is None or len(self.replicas) == 0:
+            return True
+        return not self.replicas.any_available()
 
 
 def _forest_from_source(booster=None, model_file: Optional[str] = None,
@@ -57,23 +86,50 @@ def _forest_from_source(booster=None, model_file: Optional[str] = None,
 
 
 class ModelRegistry:
-    """Thread-safe name -> ModelEntry map with LRU capacity."""
+    """Thread-safe name -> ModelEntry map with LRU capacity.
 
-    def __init__(self, max_models: int = 8):
+    `replica_factory(forest, name) -> ReplicaSet` and
+    `batcher_factory(entry) -> MicroBatcher` are injected by the
+    server so the registry stays free of routing policy; both may be
+    None (registry-only tests get bare entries).
+    """
+
+    def __init__(self, max_models: int = 8,
+                 replica_factory: Optional[Callable] = None,
+                 batcher_factory: Optional[Callable] = None):
         if max_models < 1:
             raise ValueError("max_models must be >= 1")
         self.max_models = int(max_models)
         self._entries: Dict[str, ModelEntry] = {}
         self._lock = threading.RLock()
+        self.replica_factory = replica_factory
+        self.batcher_factory = batcher_factory
+        self.swap_count = 0
 
     # ------------------------------------------------------------------
     def load(self, name: str, booster=None,
              model_file: Optional[str] = None,
              model_str: Optional[str] = None) -> ModelEntry:
         """Build + pin the device forest for `name`. Idempotent per
-        name: loading an existing name is a refresh."""
+        name: loading an existing name is a hot-swap (the previous
+        entry's batcher is drained through the host path, see
+        `Server.hot_swap`)."""
+        entry, prev = self._load_prepared(name, booster, model_file,
+                                          model_str)
+        # a plain load of an existing name still must not strand the
+        # old entry's queue; drain it here (hot_swap does its own
+        # drain + accounting before calling _load_prepared)
+        self._drain_replaced(prev)
+        return entry
+
+    def _load_prepared(self, name, booster=None, model_file=None,
+                       model_str=None):
+        """Build the full entry (forest, replicas, running batcher),
+        publish it atomically, return (entry, previous_entry)."""
         booster, forest = _forest_from_source(booster, model_file,
                                               model_str)
+        replicas = (self.replica_factory(forest, name)
+                    if self.replica_factory else None)
         with self._lock:
             prev = self._entries.get(name)
             entry = ModelEntry(
@@ -81,9 +137,16 @@ class ModelRegistry:
                 metrics=prev.metrics if prev else ModelMetrics(),
                 loaded_at=time.monotonic(),
                 version=(prev.version + 1) if prev else 1,
-                last_used=time.monotonic())
+                last_used=time.monotonic(),
+                replicas=replicas)
+            if self.batcher_factory is not None:
+                entry.batcher = self.batcher_factory(entry)
             self._entries[name] = entry
-            self._evict_over_capacity_locked()
+            if prev is not None:
+                self.swap_count += 1
+            evicted = self._evict_over_capacity_locked()
+        for old in evicted:
+            self._drain_replaced(old)
         if not forest.supported:
             Log.warning(
                 f"serving model '{name}' on the host fallback path: "
@@ -91,7 +154,20 @@ class ModelRegistry:
         Log.info(f"serving: loaded model '{name}' v{entry.version} "
                  f"({forest.num_trees} trees, "
                  f"{forest.num_features} features)")
-        return entry
+        return entry, prev
+
+    @staticmethod
+    def _drain_replaced(prev: Optional[ModelEntry]) -> int:
+        """Close a replaced/evicted entry's batcher. Queued requests
+        resolve with `BatcherClosed`; the server re-answers each via
+        the OLD entry's host path (its `_finish` closed over the
+        entry), so nothing is dropped or served by a torn model."""
+        if prev is None or prev.batcher is None:
+            return 0
+        drained = prev.batcher.close(drain_queued=False)
+        if drained:
+            prev.metrics.record_swap_drain(drained)
+        return drained
 
     def refresh(self, name: str, booster=None,
                 model_file: Optional[str] = None,
@@ -112,10 +188,12 @@ class ModelRegistry:
             return entry
 
     def evict(self, name: str) -> bool:
-        """Drop `name`; returns False when it was not loaded."""
+        """Drop `name`; returns False when it was not loaded. Queued
+        requests drain through the host path, none dropped."""
         with self._lock:
             entry = self._entries.pop(name, None)
         if entry is not None:
+            self._drain_replaced(entry)
             Log.info(f"serving: evicted model '{name}'")
         return entry is not None
 
@@ -132,10 +210,13 @@ class ModelRegistry:
             return len(self._entries)
 
     # ------------------------------------------------------------------
-    def _evict_over_capacity_locked(self) -> None:
+    def _evict_over_capacity_locked(self) -> List[ModelEntry]:
         # `_locked` suffix: caller holds the lock (docs/StaticAnalysis.md)
+        evicted: List[ModelEntry] = []
         while len(self._entries) > self.max_models:
             lru = min(self._entries.values(), key=lambda e: e.last_used)
             del self._entries[lru.name]
+            evicted.append(lru)
             Log.warning(f"serving: capacity {self.max_models} reached, "
                         f"evicted LRU model '{lru.name}'")
+        return evicted

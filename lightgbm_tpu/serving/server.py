@@ -1,23 +1,34 @@
-"""The serving facade: registry + bucket cache + micro-batcher + metrics.
+"""The serving facade: registry + replicas + micro-batcher + metrics.
 
-    server = Server(max_batch_size=512, max_wait_ms=2.0)
+    server = Server(max_batch_size=512, max_wait_ms=2.0, slo_ms=10.0)
     server.load_model("clf", booster=bst)          # one-time device load
     probs = server.predict("clf", X)               # == bst.predict(X)
+    server.hot_swap("clf", booster=bst2)           # under live traffic
     print(json.dumps(server.metrics_snapshot()))
 
 Request path: `predict` bins the rows on the host (cheap integer
-quantization), submits them to the model's `MicroBatcher`, and blocks
-on the Future; the batcher worker coalesces concurrent requests into
-one device dispatch through the shared `BucketedPredictor`. Responses
-are converted to output space host-side, so results match
+quantization), submits them to the model entry's `MicroBatcher` with
+the request's SLO deadline, and blocks on the Future; the batcher
+worker coalesces concurrent requests into one dispatch that the
+entry's `ReplicaSet` routes to the least-loaded healthy replica.
+Responses are converted to output space host-side, so results match
 `Booster.predict` (device accumulation is f32; see tests for the
 tolerance contract, and the padded-row test for the bit-identity of
 bucket padding itself).
 
-Degradation ladder: unsupported model -> host path from the start;
-device dispatch raises -> that request is served by the host path, the
-entry is marked degraded, and later requests skip the device until a
-`refresh_model`. Overload -> `OverloadError` before any work is done.
+Degradation ladder (docs/Serving.md): deadline shed at admission ->
+per-replica capped-backoff retries -> breaker opens on consecutive
+failures and traffic fails over to the next replica -> every replica
+open means host predict answers. No rung drops a request, and the
+breakers self-heal (half-open probe, auto-close) — there is no sticky
+degraded flag anymore.
+
+Hot-swap: `hot_swap` builds the new entry completely (replicas placed,
+batcher running), publishes it atomically, then drains the OLD entry's
+queue — each queued future resolves `BatcherClosed` and is re-answered
+through the old entry's host path (same binning, no torn model, no
+drop). In-flight device batches finish against the old arrays, which
+JAX keeps alive until the last reference drops.
 """
 
 from __future__ import annotations
@@ -30,15 +41,22 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from ..reliability import counters, retry_call
+from ..reliability import counters, faults
 from ..utils.log import Log, LightGBMError
 from ..utils.timer import global_timer
-from .batcher import BatcherClosed, MicroBatcher, OverloadError
+from .batcher import (BatcherClosed, DeadlineExceeded, MicroBatcher,
+                      OverloadError)
 from .engine import BucketedPredictor, max_compilations
 from .metrics import timer_totals
 from .registry import ModelEntry, ModelRegistry
+from .replicas import NoReplicaAvailable, ReplicaSet
 
-__all__ = ["Server", "OverloadError"]
+__all__ = ["Server", "OverloadError", "DeadlineExceeded"]
+
+#: what the caller sees when a request's SLO budget cannot be met:
+#: "fallback" answers it via host predict (still counted as a
+#: deadline miss), "fail" raises DeadlineExceeded fast
+DEADLINE_POLICIES = ("fallback", "fail")
 
 
 class Server:
@@ -49,8 +67,13 @@ class Server:
                  min_bucket: int = 16, max_bucket: int = 1024,
                  max_models: int = 8, retry_attempts: int = 3,
                  retry_backoff_ms: float = 50.0,
-                 retry_backoff_max_ms: float = 2000.0):
-        self.registry = ModelRegistry(max_models=max_models)
+                 retry_backoff_max_ms: float = 2000.0,
+                 slo_ms: float = 0.0, deadline_policy: str = "fallback",
+                 n_replicas: int = 1, breaker_threshold: int = 3,
+                 breaker_cooldown_ms: float = 250.0):
+        if deadline_policy not in DEADLINE_POLICIES:
+            raise ValueError(
+                f"deadline_policy must be one of {DEADLINE_POLICIES}")
         self.engine = BucketedPredictor(min_bucket=min_bucket,
                                         max_bucket=max_bucket)
         self.max_batch_size = int(max_batch_size)
@@ -59,7 +82,15 @@ class Server:
         self.retry_attempts = max(1, int(retry_attempts))
         self.retry_backoff_ms = float(retry_backoff_ms)
         self.retry_backoff_max_ms = float(retry_backoff_max_ms)
-        self._batchers: Dict[str, MicroBatcher] = {}
+        self.slo_ms = float(slo_ms)          # 0 disables deadlines
+        self.deadline_policy = deadline_policy
+        self.n_replicas = int(n_replicas)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_ms = float(breaker_cooldown_ms)
+        self.registry = ModelRegistry(
+            max_models=max_models,
+            replica_factory=self._build_replicas,
+            batcher_factory=self._build_batcher)
         self._lock = threading.Lock()
         self._closed = False
         self._metrics_server = None
@@ -75,53 +106,104 @@ class Server:
                    max_models=config.serve_max_models,
                    retry_attempts=config.retry_max_attempts,
                    retry_backoff_ms=config.retry_backoff_ms,
-                   retry_backoff_max_ms=config.retry_backoff_max_ms)
+                   retry_backoff_max_ms=config.retry_backoff_max_ms,
+                   slo_ms=config.serve_slo_ms,
+                   deadline_policy=config.serve_deadline_policy,
+                   n_replicas=config.serve_replicas,
+                   breaker_threshold=config.serve_breaker_threshold,
+                   breaker_cooldown_ms=config.serve_breaker_cooldown_ms)
+
+    # ------------------------------------------------------------------
+    # registry factories: each entry owns its replica fleet + batcher
+    def _build_replicas(self, forest, name: str) -> ReplicaSet:
+        return ReplicaSet.build(
+            forest, self.n_replicas, name=name,
+            breaker_threshold=self.breaker_threshold,
+            breaker_cooldown_ms=self.breaker_cooldown_ms)
+
+    def _build_batcher(self, entry: ModelEntry) -> MicroBatcher:
+        return MicroBatcher(
+            self._make_runner(entry),
+            max_batch_size=self.max_batch_size,
+            max_wait_ms=self.max_wait_ms,
+            max_queue=self.max_queue, name=entry.name)
+
+    def _make_runner(self, entry: ModelEntry):
+        # closes over the ENTRY, not the name: a hot-swap can never
+        # route this batcher's queued bins to a different forest
+        def run(bins: np.ndarray) -> np.ndarray:
+            if entry.replicas is None or len(entry.replicas) == 0:
+                raise NoReplicaAvailable(
+                    f"model '{entry.name}' has no device replicas")
+            return entry.replicas.dispatch(
+                self.engine, bins, metrics=entry.metrics,
+                retry_attempts=self.retry_attempts,
+                retry_backoff_ms=self.retry_backoff_ms,
+                retry_backoff_max_ms=self.retry_backoff_max_ms)
+        return run
 
     # ------------------------------------------------------------------
     # lifecycle
     def load_model(self, name: str, booster=None,
                    model_file: Optional[str] = None,
                    model_str: Optional[str] = None) -> ModelEntry:
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("server is closed")
         with global_timer.timeit("serve_model_load"):
             entry = self.registry.load(name, booster=booster,
                                        model_file=model_file,
                                        model_str=model_str)
+        return entry
+
+    def hot_swap(self, name: str, booster=None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None) -> ModelEntry:
+        """Zero-downtime model swap under live traffic.
+
+        Builds the replacement entry fully (device replicas placed,
+        fresh breakers closed, batcher worker running), publishes it
+        atomically, then closes the old entry's batcher WITHOUT
+        dispatching its queue — those futures resolve `BatcherClosed`
+        and the server re-answers each through the OLD entry's host
+        path (`swap_drains` in metrics). New requests route to the new
+        entry the moment it is published; in-flight device batches
+        finish against the old arrays. No request is dropped or served
+        by a torn model."""
         with self._lock:
             if self._closed:
                 raise RuntimeError("server is closed")
-            if name not in self._batchers:
-                self._batchers[name] = MicroBatcher(
-                    self._make_runner(name),
-                    max_batch_size=self.max_batch_size,
-                    max_wait_ms=self.max_wait_ms,
-                    max_queue=self.max_queue, name=name)
+        if name not in self.registry:
+            raise LightGBMError(f"model '{name}' is not loaded")
+        with global_timer.timeit("serve_hot_swap"):
+            # registered fault site: a swap that dies mid-way must
+            # leave the old entry serving (docs/Reliability.md)
+            faults.inject("serving_hot_swap")
+            entry, prev = self.registry._load_prepared(
+                name, booster=booster, model_file=model_file,
+                model_str=model_str)
+            drained = self.registry._drain_replaced(prev)
+        Log.info(f"serving: hot-swapped '{name}' to v{entry.version} "
+                 f"({drained} queued requests drained via host)")
         return entry
 
     def refresh_model(self, name: str, booster=None,
                       model_file: Optional[str] = None,
                       model_str: Optional[str] = None) -> ModelEntry:
-        """Swap in a new model version; clears a degraded flag."""
-        if name not in self.registry:
-            raise LightGBMError(f"model '{name}' is not loaded")
-        return self.load_model(name, booster=booster,
-                               model_file=model_file, model_str=model_str)
+        """Swap in a new model version (alias of `hot_swap`; breakers
+        start closed on the new entry's replicas)."""
+        return self.hot_swap(name, booster=booster,
+                             model_file=model_file, model_str=model_str)
 
     def evict_model(self, name: str) -> bool:
-        with self._lock:
-            batcher = self._batchers.pop(name, None)
-        if batcher is not None:
-            batcher.close()
         return self.registry.evict(name)
 
     def close(self) -> None:
         with self._lock:
             self._closed = True
-            batchers, self._batchers = dict(self._batchers), {}
             msrv, self._metrics_server = self._metrics_server, None
         if msrv is not None:
             msrv.close()
-        for b in batchers.values():
-            b.close()
         for name in self.registry.names():
             self.registry.evict(name)
 
@@ -134,73 +216,108 @@ class Server:
     # ------------------------------------------------------------------
     # request path
     def predict(self, name: str, X, raw_score: bool = False,
-                timeout: Optional[float] = None) -> np.ndarray:
+                timeout: Optional[float] = None,
+                slo_ms: Optional[float] = None) -> np.ndarray:
         """Score one request; blocks until its coalesced batch lands.
 
         Matches `Booster.predict(X, raw_score=raw_score)` output shape
         and values. Raises OverloadError when shed by admission
-        control."""
-        return self.predict_async(name, X, raw_score=raw_score) \
-            .result(timeout=timeout)
+        control; DeadlineExceeded when the SLO budget is blown and the
+        deadline policy is "fail"."""
+        try:
+            return self.predict_async(name, X, raw_score=raw_score,
+                                      slo_ms=slo_ms) \
+                .result(timeout=timeout)
+        except (OverloadError, DeadlineExceeded, LightGBMError):
+            raise                       # protocol outcomes, not crashes
+        except Exception as exc:
+            # serving fatal: an unhandled error escaping the request
+            # path gets a post-mortem like training fatals do
+            from ..observability.flightrec import recorder
+            recorder.record_exception(f"serving.predict[{name}]", exc)
+            recorder.flush("exception")
+            raise
 
-    def predict_async(self, name: str, X,
-                      raw_score: bool = False) -> Future:
-        """Non-blocking predict: a Future of the converted scores."""
+    def predict_async(self, name: str, X, raw_score: bool = False,
+                      slo_ms: Optional[float] = None) -> Future:
+        """Non-blocking predict: a Future of the converted scores.
+
+        `slo_ms` overrides the server-wide SLO budget for this request
+        (0 disables the deadline)."""
         entry = self.registry.get(name)
         t0 = time.perf_counter()
+        budget_ms = self.slo_ms if slo_ms is None else float(slo_ms)
+        deadline = (time.monotonic() + budget_ms / 1e3) \
+            if budget_ms > 0 else None
         X = np.asarray(X, np.float64)
         if X.ndim == 1:
             X = X[None, :]
         out: Future = Future()
-        if not entry.forest.supported or entry.degraded:
+        if entry.degraded:
+            # unsupported forest, or every replica breaker open with
+            # cooldowns pending: the bottom rung answers directly
             self._host_resolve(entry, X, raw_score, t0, out)
             return out
         with global_timer.timeit("serve_bin_rows"):
             bins = entry.forest.bin_rows(X)
-        with self._lock:
-            batcher = self._batchers.get(name)
+        batcher = entry.batcher
         if batcher is None:
-            # model evicted between registry.get and here: the entry is
-            # still alive in our hands, serve it on the host path
             self._host_resolve(entry, X, raw_score, t0, out)
             return out
         try:
-            raw_future = batcher.submit(bins)
+            raw_future = batcher.submit(bins, deadline=deadline)
         except OverloadError:
             entry.metrics.record_shed()
             raise
+        except DeadlineExceeded:
+            # admission projection says the queue cannot make the
+            # budget: answer NOW per policy instead of queueing a
+            # request that would expire
+            entry.metrics.record_deadline_miss()
+            if self.deadline_policy == "fail":
+                raise
+            self._host_resolve(entry, X, raw_score, t0, out)
+            return out
+        except BatcherClosed:
+            # lost the race with a concurrent hot-swap/evict closing
+            # this entry's batcher: the entry in hand still answers
+            self._host_resolve(entry, X, raw_score, t0, out)
+            return out
+
         def _finish(fut: Future) -> None:
             try:
                 raw = fut.result()
             except BatcherClosed:
-                # graceful shutdown drain: the queue is going away, the
-                # model is fine — serve this request on the host path
-                # without degrading the entry
+                # hot-swap/shutdown drain: the queue went away, the
+                # model is fine — answer through THIS entry's host
+                # path (same binning as the queued bins; no torn model)
                 Log.info(
                     f"serving model '{name}': draining request through "
                     f"host predict on batcher shutdown")
                 self._host_resolve(entry, X, raw_score, t0, out)
                 return
+            except DeadlineExceeded as exc:
+                # expired while queued (service time spiked after
+                # admission let it in)
+                entry.metrics.record_deadline_miss()
+                if self.deadline_policy == "fail":
+                    out.set_exception(exc)
+                    return
+                self._host_resolve(entry, X, raw_score, t0, out)
+                return
+            except NoReplicaAvailable:
+                # every replica breaker refused this batch: the host
+                # answers while the cooldowns run; breakers will probe
+                # and self-heal on the next dispatches
+                self._host_resolve(entry, X, raw_score, t0, out)
+                return
             except Exception as exc:
-                # device failure: degrade this entry to the host path
-                entry.degraded = True
+                # unexpected failure past retries+failover: the host
+                # still answers, and it is counted as an error
                 entry.metrics.record_error()
                 Log.warning(
                     f"serving model '{name}': device predict failed "
                     f"({exc}); falling back to host predict")
-                self._host_resolve(entry, X, raw_score, t0, out)
-                return
-            if not np.all(np.isfinite(raw)):
-                # numeric guard rail: non-finite device scores never
-                # reach a caller — recompute on the host and degrade
-                # the entry (a deterministic forest would reproduce
-                # the bad output on every later dispatch)
-                entry.degraded = True
-                entry.metrics.record_guard_trip()
-                counters.inc("guard_trips")
-                Log.warning(
-                    f"serving model '{name}': non-finite device scores; "
-                    f"falling back to host predict")
                 self._host_resolve(entry, X, raw_score, t0, out)
                 return
             try:
@@ -228,32 +345,20 @@ class Server:
         counters.inc("fallbacks")
         out.set_result(res)
 
-    def _make_runner(self, name: str):
-        def run(bins: np.ndarray) -> np.ndarray:
-            entry = self.registry.get(name)
-            # transient device faults get capped-exponential-backoff
-            # retries before the degradation ladder (host fallback)
-            # takes over; each retry is visible in the model's metrics
-            return retry_call(
-                self.engine.predict_raw, entry.forest, bins,
-                metrics=entry.metrics,
-                attempts=self.retry_attempts,
-                backoff_ms=self.retry_backoff_ms,
-                backoff_max_ms=self.retry_backoff_max_ms,
-                site=f"serving_device_predict[{name}]",
-                on_retry=entry.metrics.record_retry)
-        return run
-
     # test/ops hook: the model's queue (pause/resume/queue_depth)
     def batcher(self, name: str) -> MicroBatcher:
-        with self._lock:
-            return self._batchers[name]
+        return self.registry.get(name).batcher
+
+    # test/ops hook: the model's replica fleet (breakers, failovers)
+    def replicas(self, name: str) -> ReplicaSet:
+        return self.registry.get(name).replicas
 
     # ------------------------------------------------------------------
     # metrics
     def metrics_snapshot(self, name: Optional[str] = None) -> Dict:
-        """JSON-able snapshot: per-model request metrics + engine-wide
-        bucket-cache counters + serve_* timer phase totals."""
+        """JSON-able snapshot: per-model request metrics + per-replica
+        breaker state + engine-wide bucket-cache counters + serve_*
+        timer phase totals."""
         names = [name] if name is not None else self.registry.names()
         models = {}
         for nm in names:
@@ -263,12 +368,20 @@ class Server:
             snap["version"] = entry.version
             snap["degraded"] = entry.degraded
             snap["device_resident"] = entry.forest.supported
-            with self._lock:
-                batcher = self._batchers.get(nm)
+            if entry.replicas is not None:
+                rsnap = entry.replicas.snapshot()
+                snap["replica_count"] = rsnap["replica_count"]
+                snap["breaker_open_replicas"] = \
+                    rsnap["breaker_open_replicas"]
+                snap["replicas"] = rsnap["replicas"]
+            batcher = entry.batcher
             if batcher is not None:
                 snap["queue_depth"] = batcher.queue_depth()
                 snap["coalesced_batches"] = batcher.batch_count
                 snap["coalesced_requests"] = batcher.coalesced_requests
+                snap["deadline_shed_count"] = batcher.deadline_shed_count
+                snap["deadline_expired_count"] = \
+                    batcher.deadline_expired_count
             models[nm] = snap
         return {
             "models": models,
@@ -291,14 +404,29 @@ class Server:
 
     def prometheus_text(self) -> str:
         """Prometheus text-exposition (0.0.4) body: per-model request
-        metrics (label model="<name>"), engine-wide bucket-cache
-        counters, serve timers, plus the process-global observability
-        registry (training telemetry, compiles, MFU, reliability)."""
+        metrics (label model="<name>"), per-replica breaker gauges
+        (labels model=, replica=), engine-wide bucket-cache counters,
+        serve timers, plus the process-global observability registry
+        (training telemetry, compiles, MFU, reliability)."""
         from ..observability import registry as _obs
         from ..observability.export import render_prometheus
         snap = self.metrics_snapshot()
-        sections = [(m, "lightgbm_tpu_serving_model", {"model": nm})
-                    for nm, m in snap["models"].items()]
+        sections = []
+        for nm, m in snap["models"].items():
+            reps = m.pop("replicas", [])
+            sections.append((m, "lightgbm_tpu_serving_model",
+                             {"model": nm}))
+            for rep in reps:
+                sections.append((
+                    {"breaker_state": rep["state_code"],
+                     "breaker_opens": rep["opens"],
+                     "breaker_closes": rep["closes"],
+                     "breaker_probes": rep["probes"],
+                     "inflight": rep["inflight"],
+                     "dispatches": rep["dispatches"],
+                     "failures": rep["failures"]},
+                    "lightgbm_tpu_serving_replica",
+                    {"model": nm, "replica": str(rep["replica"])}))
         sections.append((snap["engine"], "lightgbm_tpu_serving_engine",
                          None))
         return render_prometheus(sections) + _obs.prometheus_text()

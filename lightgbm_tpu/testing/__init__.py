@@ -5,5 +5,9 @@
   two-process test and the chaos harness.
 - `chaos`: rank-death chaos harness (kill one rank mid-collective,
   diagnose, resume) — docs/Reliability.md "Distributed fault model".
+- `chaos_serve`: serving chaos + load harness (dyadic boosters for
+  bit-identical device/host answers, closed/open-loop heavy-tailed
+  load generation, chaos orchestration hooks) — docs/Serving.md
+  "Degradation ladder".
 - `dask_stub`: minimal dask-like cluster stand-in for dask.py tests.
 """

@@ -37,8 +37,9 @@ def _bench_rec(value, rc=0, metric="higgs1m_trees_per_sec", **extra):
 def test_real_trajectory_schema_validates():
     traj = regress.load_trajectory(REPO)
     assert traj["bench"], "no BENCH_r*.json in the repo root"
+    assert traj["serve"], "no SERVE_r*.json in the repo root"
     problems = []
-    for kind in ("bench", "multichip"):
+    for kind in ("bench", "multichip", "serve"):
         for _, name, rec in traj[kind]:
             problems += regress.validate_record(kind, name, rec)
     assert not problems, "\n".join(problems)
@@ -48,8 +49,23 @@ def test_real_trajectory_has_no_regressions():
     result = regress.compare()
     assert result["root"] == REPO
     assert result["regressions"] == [], regress.render_compare(result)
-    # the headline metric is tracked with best-so-far context
+    # the headline metrics are tracked with best-so-far context
     assert "higgs1m_trees_per_sec" in result["metrics"]
+    assert "serve:serve_sustained_qps_p99lt10ms" in result["metrics"]
+
+
+def test_real_serve_record_holds_the_slo():
+    """The committed SERVE_r*.json must be a usable sample: rc==0, a
+    positive sustained QPS, p99 under the 10ms SLO, and zero drops in
+    every stage (the bench_serve.py contract the sentinel guards)."""
+    (_, name, rec) = regress.load_trajectory(REPO)["serve"][-1]
+    assert regress.validate_record("serve", name, rec) == []
+    assert rec["rc"] == 0
+    parsed = rec["parsed"]
+    assert parsed["metric"] == "serve_sustained_qps_p99lt10ms"
+    assert parsed["unit"] == "qps" and parsed["value"] > 0
+    assert parsed["slo_held"] is True and parsed["p99_ms"] < 10.0
+    assert all(s["dropped"] == 0 for s in parsed["stages"])
 
 
 # ---------------------------------------------------------------------------
@@ -102,6 +118,26 @@ def test_broken_latest_record_is_a_regression(tmp_path):
     (reg,) = result["regressions"]
     assert reg["metric"] == "bench_record"
     assert reg["record"] == "BENCH_r02.json"
+
+
+def test_serve_series_regressions_flagged(tmp_path):
+    """SERVE_r*.json rides the bench schema: a QPS drop beyond the
+    threshold and a broken latest serve round both fire, under the
+    'serve:' metric namespace."""
+    rec = lambda v, rc=0: _bench_rec(v, rc=rc,
+                                     metric="serve_sustained_qps_p99lt10ms")
+    _write(tmp_path, "SERVE_r01.json", rec(800.0))
+    _write(tmp_path, "SERVE_r02.json", rec(500.0))       # -37.5%
+    result = regress.compare(str(tmp_path))
+    (reg,) = result["regressions"]
+    assert reg["metric"] == "serve:serve_sustained_qps_p99lt10ms"
+    assert reg["best"] == 800.0
+    # a crashed latest serve bench is itself a regression
+    _write(tmp_path, "SERVE_r03.json", rec(None, rc=1))
+    result = regress.compare(str(tmp_path))
+    assert {r["metric"] for r in result["regressions"]} == {
+        "serve:serve_sustained_qps_p99lt10ms", "serve_record"}
+    assert result["serve_records"] == 3
 
 
 def test_multichip_flip_is_a_regression(tmp_path):

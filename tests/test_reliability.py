@@ -224,14 +224,20 @@ class TestFaultSites:
         X, y, ds = _ds()
         bst = lgb.train(dict(PARAMS), ds, num_boost_round=3)
         from lightgbm_tpu.serving import Server
+        # breaker_threshold=1: one exhausted dispatch opens the sole
+        # replica's breaker, so `degraded` (now a derived breaker
+        # property, not a sticky flag) reads True until the cooldown
+        # probe heals it (docs/Serving.md "Degradation ladder")
         with Server(max_wait_ms=0.5, retry_attempts=2,
-                    retry_backoff_ms=1.0) as srv:
+                    retry_backoff_ms=1.0, breaker_threshold=1,
+                    breaker_cooldown_ms=60000.0) as srv:
             srv.load_model("m", booster=bst)
             faults.schedule("serving_device_predict", fail=10)
             out = srv.predict("m", X[:8])
             snap = srv.metrics_snapshot("m")["models"]["m"]
         np.testing.assert_allclose(out, bst.predict(X[:8]), rtol=1e-6)
         assert snap["degraded"]
+        assert snap["replicas"][0]["state"] == "open"
         assert snap["fallbacks"] == 1
         assert counters.get("fallbacks") == 1
 

@@ -1,0 +1,224 @@
+"""Serving chaos + load tests (docs/Serving.md "Degradation ladder").
+
+Sustained concurrent load from the `testing.chaos_serve` harness while
+the fault registry kills replica dispatches, a breaker is forced open,
+and the model is hot-swapped mid-run. The ledger then proves the
+ISSUE-11 acceptance criteria exactly:
+
+- zero requests dropped or left hanging (every issued request gets a
+  definitive outcome);
+- every answer bit-identical to a host predict of the same rows
+  (dyadic boosters make f32 device sums == f64 host sums, so a torn
+  model or corrupted batch slice cannot hide inside a tolerance);
+- the breaker observed opening, half-open probing, and re-closing via
+  the metrics snapshot alone.
+
+The fast subset here is tier-1; the full open-loop QPS ramp is marked
+`slow` and runs via `make serve-chaos`.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.reliability import InjectedFault, faults
+from lightgbm_tpu.serving import Server
+from lightgbm_tpu.testing.chaos_serve import (dyadic_booster,
+                                              heavy_tailed_sizes,
+                                              run_closed_loop,
+                                              run_open_loop,
+                                              verify_bit_identical)
+
+pytestmark = pytest.mark.serve_chaos
+
+
+@pytest.fixture(scope="module")
+def dyadic():
+    return dyadic_booster(seed=3)
+
+
+@pytest.fixture(scope="module")
+def dyadic_v2():
+    return dyadic_booster(seed=11)
+
+
+def test_dyadic_booster_is_bit_exact_on_device(dyadic):
+    bst, X = dyadic
+    with Server(min_bucket=4, max_bucket=256) as srv:
+        srv.load_model("m", booster=bst)
+        got = srv.predict("m", X[:200], raw_score=True)
+    assert np.array_equal(got, bst.predict(X[:200], raw_score=True))
+
+
+def test_heavy_tailed_sizes_shape():
+    rng = np.random.RandomState(0)
+    sizes = heavy_tailed_sizes(rng, 5000, max_rows=64)
+    assert sizes.min() >= 1 and sizes.max() <= 64
+    # genuinely heavy-tailed: most requests tiny, some near the cap
+    assert np.median(sizes) <= 8 and sizes.max() >= 32
+
+
+def test_chaos_closed_loop_faults_breaker_and_hot_swap(dyadic,
+                                                      dyadic_v2):
+    """The acceptance scenario: concurrent load + injected device
+    faults + forced breaker open + mid-run hot-swap. Zero drops, bit
+    identity, breaker trip/heal all observed from metrics."""
+    bst, X = dyadic
+    bst2, _ = dyadic_v2
+    faults.clear()
+    with Server(min_bucket=4, max_bucket=256, n_replicas=2,
+                retry_attempts=1, breaker_threshold=2,
+                breaker_cooldown_ms=50.0, max_queue=512,
+                slo_ms=30000.0) as srv:
+        srv.load_model("m", booster=bst)
+
+        def _chaos(_i):
+            # rung 2-3: injected device failures on replica dispatch —
+            # enough consecutive ones to trip a breaker naturally
+            faults.schedule("serving_replica_predict", fail=3)
+            # hot-swap under live traffic (fresh replicas + breakers;
+            # queued requests drain via the old entry's host path)
+            srv.hot_swap("m", booster=bst2)
+            # rung 4-5: force the new entry's replica 0 open so
+            # failover routes everything to replica 1 for a while
+            srv.replicas("m").replicas()[0].breaker.force_open()
+
+        res = run_closed_loop(srv, "m", X, n_requests=160, workers=6,
+                              max_rows=48, raw_score=True,
+                              timeout_s=60.0, seed=1, mid_run=_chaos)
+
+        # --- zero dropped / hanging requests, exact accounting
+        assert res.dropped == 0, res.by_outcome()
+        outcomes = res.by_outcome()
+        assert set(outcomes) <= {"ok", "shed", "deadline"}, outcomes
+        assert outcomes.get("ok", 0) >= 150   # sheds are rare at 512 cap
+
+        # --- bit identity: every answer equals host predict of the
+        # same rows under the OLD or NEW model (never a torn mixture)
+        mismatched = 0
+        for rec in res.ok_records():
+            ref_old = bst.predict(X[rec.lo:rec.hi], raw_score=True)
+            ref_new = bst2.predict(X[rec.lo:rec.hi], raw_score=True)
+            val = np.asarray(rec.value)
+            if not (np.array_equal(val, ref_old) or
+                    np.array_equal(val, ref_new)):
+                mismatched += 1
+        assert mismatched == 0
+
+        # --- fault sites actually fired and the ladder absorbed them
+        assert faults.trips("serving_replica_predict") >= 1
+        snap = srv.metrics_snapshot("m")["models"]["m"]
+        assert snap["version"] == 2
+
+        # --- breaker trip observed in metrics (force_open + injected
+        # failures), and it self-heals: after the cooldown, traffic
+        # probes the open replica and closes it again
+        reps = {r["replica"]: r for r in snap["replicas"]}
+        assert reps[0]["opens"] >= 1
+        time.sleep(0.1)                    # cooldown (50ms) elapses
+        for i in range(12):
+            srv.predict("m", X[i:i + 4], raw_score=True)
+        snap = srv.metrics_snapshot("m")["models"]["m"]
+        reps = {r["replica"]: r for r in snap["replicas"]}
+        assert reps[0]["state"] == "closed"
+        assert reps[0]["probes"] >= 1 and reps[0]["closes"] >= 1
+        assert snap["degraded"] is False
+    faults.clear()
+
+
+def test_chaos_every_replica_open_host_answers(dyadic):
+    """Bottom rung: with every breaker open and cooldowns pending, the
+    host path answers everything — still bit-identical, still zero
+    drops."""
+    bst, X = dyadic
+    faults.clear()
+    with Server(min_bucket=4, max_bucket=256, n_replicas=2,
+                retry_attempts=1, breaker_threshold=1,
+                breaker_cooldown_ms=60000.0, max_queue=512) as srv:
+        srv.load_model("m", booster=bst)
+        for rep in srv.replicas("m").replicas():
+            rep.breaker.force_open()
+        assert srv.metrics_snapshot("m")["models"]["m"]["degraded"] \
+            is True
+        res = run_closed_loop(srv, "m", X, n_requests=40, workers=4,
+                              max_rows=32, raw_score=True, seed=2)
+        assert res.dropped == 0
+        assert verify_bit_identical(res, bst, X) == len(res.ok_records())
+        snap = srv.metrics_snapshot("m")["models"]["m"]
+        assert snap["fallback_count"] >= len(res.ok_records())
+
+
+def test_hot_swap_fault_leaves_old_model_serving(dyadic, dyadic_v2):
+    """A fault at the `serving_hot_swap` site fires before the
+    replacement entry is built: the swap raises, the old model keeps
+    serving bit-identically at its old version."""
+    bst, X = dyadic
+    bst2, _ = dyadic_v2
+    with Server(min_bucket=4, max_bucket=256) as srv:
+        srv.load_model("m", booster=bst)
+        with faults.injected("serving_hot_swap", fail=1):
+            with pytest.raises(InjectedFault):
+                srv.hot_swap("m", booster=bst2)
+        assert faults.trips("serving_hot_swap") >= 1
+        snap = srv.metrics_snapshot("m")["models"]["m"]
+        assert snap["version"] == 1 and snap["swap_drains"] == 0
+        got = srv.predict("m", X[:50], raw_score=True)
+        assert np.array_equal(got, bst.predict(X[:50], raw_score=True))
+
+
+def test_deadline_misses_under_pressure(dyadic):
+    """A hopeless SLO forces admission sheds; policy 'fallback' still
+    answers every request via host — deadline_misses and zero drops."""
+    bst, X = dyadic
+    with Server(min_bucket=4, max_bucket=256, slo_ms=0.001,
+                deadline_policy="fallback", max_queue=512) as srv:
+        srv.load_model("m", booster=bst)
+        srv.batcher("m").pause()          # queue wait projection blows
+        srv.predict_async("m", X[:4], raw_score=True)   # seeds queue
+        res = run_closed_loop(srv, "m", X, n_requests=30, workers=3,
+                              max_rows=16, raw_score=True, seed=3)
+        assert res.dropped == 0
+        assert verify_bit_identical(res, bst, X) == len(res.ok_records())
+        snap = srv.metrics_snapshot("m")["models"]["m"]
+        assert snap["deadline_misses"] >= 1
+        srv.batcher("m").resume()
+
+
+@pytest.mark.slow
+def test_chaos_open_loop_qps_ramp(dyadic, dyadic_v2):
+    """Full open-loop QPS ramp with chaos at stage boundaries: faults
+    at the second stage, hot-swap at the third. Zero drops and p99
+    under load are recorded; bit identity holds across the swap."""
+    bst, X = dyadic
+    bst2, _ = dyadic_v2
+    faults.clear()
+    with Server(min_bucket=4, max_bucket=256, n_replicas=2,
+                retry_attempts=1, breaker_threshold=2,
+                breaker_cooldown_ms=50.0, max_queue=2048) as srv:
+        srv.load_model("m", booster=bst)
+
+        def _chaos(stage):
+            if stage == 1:
+                faults.schedule("serving_replica_predict", fail=4)
+            elif stage == 2:
+                srv.hot_swap("m", booster=bst2)
+
+        res = run_open_loop(srv, "m", X,
+                            stages=[(50, 2.0), (150, 2.0), (300, 2.0)],
+                            max_rows=48, raw_score=True,
+                            timeout_s=60.0, seed=4, mid_run=_chaos)
+        assert res.dropped == 0, res.by_outcome()
+        assert res.by_outcome().get("error", 0) == 0
+        for rec in res.ok_records():
+            val = np.asarray(rec.value)
+            assert (np.array_equal(
+                        val, bst.predict(X[rec.lo:rec.hi],
+                                         raw_score=True)) or
+                    np.array_equal(
+                        val, bst2.predict(X[rec.lo:rec.hi],
+                                          raw_score=True)))
+        pct = res.latency_percentiles()
+        assert pct["p99_ms"] > 0.0
+        assert faults.trips("serving_replica_predict") >= 1
+    faults.clear()

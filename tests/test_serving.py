@@ -4,6 +4,7 @@ CPU-fallback parity, metrics snapshot schema, CLI task=serve."""
 
 import json
 import os
+import time
 
 import numpy as np
 import pytest
@@ -299,10 +300,14 @@ def test_server_file_loaded_model_parity(binary_model, tmp_path):
 
 
 def test_server_cpu_fallback_parity(binary_model, monkeypatch):
-    """Device failure degrades to the host predict path; results still
-    exactly match Booster.predict."""
+    """Device failure falls back to the host predict path (results
+    still exactly match Booster.predict), consecutive failures open
+    the replica breaker, and the breaker self-heals once the device
+    recovers — no manual refresh needed (contrast the PR-1 sticky
+    degraded flag)."""
     bst, X, _ = binary_model
-    with Server(min_bucket=4, max_bucket=64) as srv:
+    with Server(min_bucket=4, max_bucket=64, retry_attempts=1,
+                breaker_threshold=2, breaker_cooldown_ms=150.0) as srv:
         srv.load_model("m", booster=bst)
 
         def boom(*a, **k):
@@ -313,15 +318,32 @@ def test_server_cpu_fallback_parity(binary_model, monkeypatch):
         ref = bst.predict(X[:21])
         assert np.array_equal(got, ref)   # identical: same host code path
         snap = srv.metrics_snapshot("m")["models"]["m"]
-        assert snap["degraded"] is True
-        assert snap["fallback_count"] >= 1 and snap["errors"] >= 1
-        # degraded entries skip the device entirely from then on
+        assert snap["fallback_count"] >= 1
+        # a second failing dispatch reaches the 2-failure threshold:
+        # the replica breaker opens and the entry degrades (derived,
+        # not sticky)
         got2 = srv.predict("m", X[:5])
         assert np.array_equal(got2, bst.predict(X[:5]))
-        # refresh clears the degradation
+        breaker = srv.replicas("m").replicas()[0].breaker
+        assert breaker.state == "open"
+        assert srv.metrics_snapshot("m")["models"]["m"]["degraded"] \
+            is True
+        # device recovers: once the cooldown elapses the next dispatch
+        # is a half-open probe, and one clean batch re-closes the
+        # breaker — self-healing, no refresh_model required
         monkeypatch.undo()
-        srv.refresh_model("m", booster=bst)
-        assert srv.metrics_snapshot("m")["models"]["m"]["degraded"] is False
+        time.sleep(0.2)
+        got3 = srv.predict("m", X[:9])
+        # device path again (f32 accumulation): tolerance, not bits
+        np.testing.assert_allclose(got3, bst.predict(X[:9]),
+                                   rtol=RTOL, atol=ATOL)
+        # the probe dispatch may have been the healing one; poke once
+        # more to be robust to batching boundaries
+        srv.predict("m", X[:3])
+        assert breaker.state == "closed"
+        assert breaker.opens >= 1 and breaker.closes >= 1
+        snap = srv.metrics_snapshot("m")["models"]["m"]
+        assert snap["degraded"] is False
 
 
 def test_server_unsupported_model_host_path():
@@ -437,3 +459,301 @@ def test_cli_task_serve(tmp_path):
     assert m["rows"] == 200 and m["shed_count"] == 0
     assert m["buckets_compiled"] <= snap["engine"][
         "max_compilations_per_model"]
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker (serving/breaker.py)
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_opens_on_consecutive_failures_only():
+    from lightgbm_tpu.serving import CircuitBreaker
+    clk = _FakeClock()
+    br = CircuitBreaker(threshold=3, cooldown_s=1.0, clock=clk)
+    assert br.state == "closed" and br.try_acquire()
+    br.record_failure()
+    br.record_failure()
+    br.record_success()          # resets the consecutive count
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"  # 2 consecutive < threshold 3
+    br.record_failure()
+    assert br.state == "open" and br.opens == 1
+    # open refuses until the cooldown elapses
+    assert not br.try_acquire() and not br.available()
+    clk.t += 1.5
+    assert br.available()
+
+
+def test_breaker_half_open_single_probe_and_heal():
+    from lightgbm_tpu.serving import CircuitBreaker, breaker_state_code
+    clk = _FakeClock()
+    br = CircuitBreaker(threshold=1, cooldown_s=1.0, clock=clk)
+    br.record_failure()
+    assert br.state == "open"
+    clk.t += 2.0
+    assert br.try_acquire()           # the single half-open probe
+    assert br.state == "half_open"
+    assert not br.try_acquire()       # concurrent dispatch refused
+    br.record_success()
+    assert br.state == "closed" and br.closes == 1 and br.probes == 1
+    snap = br.snapshot()
+    assert snap["state_code"] == breaker_state_code("closed") == 0
+
+
+def test_breaker_probe_failure_reopens():
+    from lightgbm_tpu.serving import CircuitBreaker
+    clk = _FakeClock()
+    br = CircuitBreaker(threshold=1, cooldown_s=1.0, clock=clk)
+    br.record_failure()
+    clk.t += 1.1
+    assert br.try_acquire()
+    br.record_failure()               # probe failed
+    assert br.state == "open" and br.opens == 2
+    assert not br.try_acquire()       # cooldown restarted
+    clk.t += 1.1
+    assert br.try_acquire()
+    br.record_success()
+    assert br.state == "closed"
+
+
+def test_breaker_force_open():
+    from lightgbm_tpu.serving import CircuitBreaker
+    br = CircuitBreaker(threshold=5, cooldown_s=60.0)
+    br.force_open()
+    assert br.state == "open" and not br.available()
+
+
+# ---------------------------------------------------------------------------
+# SLO deadlines (serving/batcher.py + server policy)
+
+
+def test_deadline_shed_at_admission():
+    """With the worker paused and the queue non-empty, a request whose
+    budget is below the projected wait is shed at submit."""
+    from lightgbm_tpu.serving import DeadlineExceeded
+
+    done = []
+    b = MicroBatcher(lambda bins: np.zeros((len(bins), 1)),
+                     max_batch_size=8, max_wait_ms=1.0, name="slo")
+    try:
+        b.pause()
+        bins = np.zeros((4, 3), np.int32)
+        f1 = b.submit(bins, deadline=None)          # no budget: queues
+        with pytest.raises(DeadlineExceeded):
+            # 0.1ms budget cannot cover even one EMA service time
+            b.submit(bins, deadline=time.monotonic() + 1e-4)
+        assert b.deadline_shed_count == 1
+        # a generous budget is admitted
+        f2 = b.submit(bins, deadline=time.monotonic() + 60.0)
+        b.resume()
+        assert f1.result(timeout=5.0).shape == (4, 1)
+        assert f2.result(timeout=5.0).shape == (4, 1)
+        done.append(True)
+    finally:
+        b.close()
+    assert done
+
+
+def test_deadline_expiry_in_queue():
+    """A request admitted but stuck past its deadline expires at
+    dispatch with DeadlineExceeded — never silently dropped."""
+    from lightgbm_tpu.serving import DeadlineExceeded
+
+    b = MicroBatcher(lambda bins: np.zeros((len(bins), 1)),
+                     max_batch_size=8, max_wait_ms=1.0, name="slo2")
+    try:
+        b.pause()
+        bins = np.zeros((2, 3), np.int32)
+        fut = b.submit(bins, deadline=time.monotonic() + 0.05)
+        time.sleep(0.15)                  # let it expire while paused
+        b.resume()
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=5.0)
+        assert b.deadline_expired_count == 1
+    finally:
+        b.close()
+
+
+def test_server_deadline_policy_fallback_and_fail(binary_model):
+    """Policy 'fallback' answers a blown-budget request via host
+    predict (counted as a deadline miss); policy 'fail' raises."""
+    from lightgbm_tpu.serving import DeadlineExceeded
+    bst, X, _ = binary_model
+    with Server(min_bucket=4, max_bucket=64, slo_ms=0.001,
+                deadline_policy="fallback") as srv:
+        srv.load_model("m", booster=bst)
+        srv.batcher("m").pause()          # make the projection hopeless
+        srv.predict("m", X[:4])           # seed the queue
+        got = srv.predict("m", X[:7])
+        assert np.array_equal(got, bst.predict(X[:7]))
+        snap = srv.metrics_snapshot("m")["models"]["m"]
+        assert snap["deadline_misses"] >= 1
+        assert snap["fallback_count"] >= 1
+    with Server(min_bucket=4, max_bucket=64, slo_ms=0.001,
+                deadline_policy="fail") as srv:
+        srv.load_model("m", booster=bst)
+        srv.batcher("m").pause()
+        try:
+            srv.predict("m", X[:4])
+        except DeadlineExceeded:
+            pass
+        with pytest.raises(DeadlineExceeded):
+            srv.predict("m", X[:7])
+
+
+# ---------------------------------------------------------------------------
+# replica failover + hot swap + drain races
+
+
+def test_replica_failover_on_injected_faults(binary_model):
+    """With 2 replicas and injected faults on replica dispatch, the
+    batch fails over and still answers; failovers are counted."""
+    from lightgbm_tpu.reliability import faults
+    bst, X, _ = binary_model
+    with Server(min_bucket=4, max_bucket=64, n_replicas=2,
+                retry_attempts=1, breaker_threshold=1,
+                breaker_cooldown_ms=60000.0) as srv:
+        srv.load_model("m", booster=bst)
+        assert len(srv.replicas("m")) == 2
+        with faults.injected("serving_replica_predict", fail=1):
+            got = srv.predict("m", X[:9])
+        np.testing.assert_allclose(got, bst.predict(X[:9]), rtol=RTOL,
+                                   atol=ATOL)
+        snap = srv.metrics_snapshot("m")["models"]["m"]
+        assert snap["failovers"] >= 1
+        assert snap["breaker_open_replicas"] == 1
+        states = {r["replica"]: r["state"] for r in snap["replicas"]}
+        assert "open" in states.values() and "closed" in states.values()
+        # the open replica is out of rotation; traffic still flows
+        got2 = srv.predict("m", X[:5])
+        np.testing.assert_allclose(got2, bst.predict(X[:5]), rtol=RTOL,
+                                   atol=ATOL)
+
+
+def test_hot_swap_drains_queue_through_old_model(binary_model):
+    """Queued requests at hot-swap resolve via the OLD entry's host
+    path (bit-identical to the old booster), new requests hit the new
+    version — zero drops, no torn model."""
+    bst, X, _ = binary_model
+    X2, y2 = make_binary(n=400, f=X.shape[1], seed=99)
+    bst2 = lgb.train({"objective": "binary", "num_leaves": 9,
+                      "verbosity": -1}, lgb.Dataset(X2, label=y2),
+                     num_boost_round=5)
+    with Server(min_bucket=4, max_bucket=64) as srv:
+        srv.load_model("m", booster=bst)
+        srv.batcher("m").pause()
+        futs = [srv.predict_async("m", X[i:i + 3]) for i in range(6)]
+        entry = srv.hot_swap("m", booster=bst2)
+        assert entry.version == 2
+        for i, f in enumerate(futs):
+            got = f.result(timeout=10.0)
+            assert np.array_equal(got, bst.predict(X[i:i + 3]))
+        snap = srv.metrics_snapshot("m")["models"]["m"]
+        assert snap["swap_drains"] == 6
+        assert snap["requests"] == 6          # each counted exactly once
+        got_new = srv.predict("m", X[:11])
+        np.testing.assert_allclose(got_new, bst2.predict(X[:11]),
+                                   rtol=RTOL, atol=ATOL)
+        assert srv.metrics_snapshot("m")["models"]["m"]["version"] == 2
+
+
+def test_batcher_closed_drain_races_concurrent_evict(binary_model):
+    """The satellite race: queued futures vs a concurrent registry
+    evict. Every future resolves (host path), none hangs, and the
+    metrics account each request exactly once."""
+    import threading
+    bst, X, _ = binary_model
+    with Server(min_bucket=4, max_bucket=64) as srv:
+        entry = srv.load_model("m", booster=bst)
+        srv.batcher("m").pause()
+        futs = [srv.predict_async("m", X[i:i + 2]) for i in range(8)]
+        stop = threading.Event()
+        racers = []
+
+        def _evict():
+            stop.wait()
+            srv.evict_model("m")
+
+        def _late_submits():
+            stop.wait()
+            # these race the close: either queued-then-drained or
+            # refused with BatcherClosed at submit — both host-resolve
+            for i in range(4):
+                futs.append(srv.predict_async("m", X[i:i + 2]))
+
+        racers = [threading.Thread(target=_evict),
+                  threading.Thread(target=_late_submits)]
+        for t in racers:
+            t.start()
+        stop.set()
+        for t in racers:
+            t.join(timeout=10.0)
+        for i, f in enumerate(futs):
+            got = f.result(timeout=10.0)
+            assert np.array_equal(got, bst.predict(X[i % 8:i % 8 + 2])) \
+                or got.shape == (2,)
+        # exactly-once accounting on the evicted entry's metrics
+        assert entry.metrics.requests == len(futs)
+        assert "m" not in srv.registry.names()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_batcher_worker_death_flightrec_and_no_hang(tmp_path):
+    """A batcher worker thread dying flushes a postmortem bundle and
+    resolves every queued future with BatcherClosed — nothing hangs."""
+    from lightgbm_tpu.observability.flightrec import recorder
+    from lightgbm_tpu.serving import BatcherClosed
+
+    recorder.configure(enabled=True, out_dir=str(tmp_path))
+    recorder.reset()
+
+    def _die(bins):
+        raise KeyboardInterrupt("worker killed")   # escapes Exception
+
+    b = MicroBatcher(_die, max_batch_size=4, max_wait_ms=0.5,
+                     name="doomed")
+    fut = b.submit(np.zeros((2, 3), np.int32))
+    with pytest.raises(BatcherClosed):
+        fut.result(timeout=10.0)
+    # callers are unblocked first; the post-mortem flush lands moments
+    # later on the dying worker thread
+    deadline = time.monotonic() + 5.0
+    bundles = []
+    while not bundles and time.monotonic() < deadline:
+        bundles = list(tmp_path.glob("postmortem_*.json"))
+        time.sleep(0.02)
+    assert bundles, "worker death must flush a flight-recorder bundle"
+    rec = json.loads(bundles[0].read_text())
+    evs = [e for e in rec["events"] if e.get("kind") == "exception"]
+    assert any("serving_batcher_worker" in e.get("name", "")
+               for e in evs)
+    recorder.configure(out_dir="")
+    with pytest.raises(BatcherClosed):
+        b.submit(np.zeros((1, 3), np.int32))
+
+
+def test_prometheus_replica_breaker_rows(binary_model):
+    """Per-replica breaker gauges are exported with model+replica
+    labels under the lightgbm_tpu_serving_replica family."""
+    bst, X, _ = binary_model
+    with Server(min_bucket=4, max_bucket=64, n_replicas=2) as srv:
+        srv.load_model("m", booster=bst)
+        srv.predict("m", X[:5])
+        text = srv.prometheus_text()
+    assert ('lightgbm_tpu_serving_replica_breaker_state'
+            '{model="m",replica="0"} 0') in text
+    assert ('lightgbm_tpu_serving_replica_breaker_state'
+            '{model="m",replica="1"} 0') in text
+    assert 'lightgbm_tpu_serving_model_deadline_misses{model="m"}' \
+        in text
+    assert 'lightgbm_tpu_serving_model_failovers{model="m"}' in text
+    assert 'lightgbm_tpu_serving_model_swap_drains{model="m"}' in text
