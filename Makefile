@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-all faults chaos postmortem observe lint lint-sarif pipeline kernels stream bench serve-chaos serve-bench install
+.PHONY: test test-slow test-all faults chaos postmortem distributed observe lint lint-sarif pipeline kernels stream bench serve-chaos serve-bench install
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -65,6 +65,16 @@ chaos:
 # docs/Observability.md "Post-mortem workflow")
 postmortem:
 	$(PY) -m pytest tests/test_chaos.py -x -q -m chaos -k postmortem
+
+# the distributed-learner tier: crossbar byte-parity oracles (serial vs
+# data-parallel reduce-scatter, bit-for-bit), hist_agg/binning units,
+# fault-site + provision-latch checks (tests/test_distributed_learner.py,
+# docs/Distributed.md) — fast subset is tier-1; the second invocation
+# adds full-task parity, fused determinism, and the 8-device rank-death
+# chaos scenario
+distributed:
+	$(PY) -m pytest tests/test_distributed_learner.py -x -q -m "distributed and not slow"
+	$(PY) -m pytest tests/test_distributed_learner.py -x -q -m "distributed and slow"
 
 # the serving chaos tier: concurrent load while the fault registry
 # kills replica dispatches, breakers trip/heal, and the model is

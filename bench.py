@@ -415,6 +415,114 @@ def _stream_bench(result, spec):
               file=sys.stderr)
 
 
+def _multichip_worker_main(argv):
+    """``bench.py --multichip-worker`` (spawned by --multichip with the
+    device count forced in XLA_FLAGS): stream the --synth dataset
+    through the two-pass loader, train tree_learner=data through the
+    fused+pipelined executor over every visible device, and print ONE
+    JSON line with the measured steady-state trees/sec."""
+    import jax
+    import lightgbm_tpu as lgb
+    from helpers.synth import SynthSource
+    from lightgbm_tpu.observability import registry as _obs
+
+    spec = _parse_synth_argv(argv) or \
+        {"rows": 1_000_000, "cols": 28, "chunk": 262144, "seed": 17}
+    n_leaves = int(os.environ.get("BENCH_MC_LEAVES", 63))
+    n_trees = int(os.environ.get("BENCH_MC_TREES", 30))
+    warmup = int(os.environ.get("BENCH_MC_WARMUP", 12))
+    ndev = len(jax.devices())
+    src = SynthSource(rows=spec["rows"], cols=spec["cols"],
+                      chunk_rows=spec["chunk"], seed=spec["seed"])
+    t0 = time.perf_counter()
+    ds = lgb.Dataset(src, params={"max_bin": MAX_BIN}).construct()
+    ingest_s = time.perf_counter() - t0
+    params = dict(PARAMS, num_leaves=n_leaves, tree_learner="data",
+                  pipeline=True, use_quantized_grad=False)
+    _obs.enable()
+    # warmup compiles every dispatch shape (iteration-0 per-iteration
+    # path + the fused sharded block); the timed run below re-hits the
+    # process-global jit cache, so it measures steady-state training
+    lgb.train(params, ds, num_boost_round=warmup)
+    t0 = time.perf_counter()
+    lgb.train(params, ds, num_boost_round=n_trees)
+    train_s = time.perf_counter() - t0
+    dist = _obs.distributed_snapshot()
+    rate = n_trees / train_s if train_s > 0 else 0.0
+    print(json.dumps({
+        "n_devices": ndev, "tree_learner": "data",
+        "trees_per_sec": round(rate, 3),
+        "vs_baseline": round(rate / BASELINE_TREES_PER_SEC, 3),
+        "num_leaves": n_leaves, "trees": n_trees,
+        "rows": spec["rows"], "cols": spec["cols"],
+        "ingest_s": round(ingest_s, 3),
+        "train_s": round(train_s, 3),
+        "world": dist["world"],
+        "feature_shard_width": dist["feature_shard_width"]}))
+    sys.stdout.flush()
+    return 0
+
+
+def _multichip_main(argv):
+    """``bench.py --multichip [--devices N] [--out PATH] [--synth ...]``:
+    real multi-device training benchmark. Spawns a worker process with
+    ``--xla_force_host_platform_device_count=N`` appended to XLA_FLAGS
+    (visible-only on the host platform: real chips are untouched, CPU
+    CI gets N virtual devices) and wraps the worker's JSON line into
+    the MULTICHIP_r*.json record shape the regression sentinel tracks
+    (observability/regress.py): n_devices/rc/ok/skipped/tail plus the
+    measured trees_per_sec, vs_baseline and tree_learner."""
+    import subprocess
+    ndev, out = 8, "MULTICHIP_r06.json"
+    for i, a in enumerate(argv):
+        if a == "--devices" and i + 1 < len(argv):
+            ndev = int(argv[i + 1])
+        elif a.startswith("--devices="):
+            ndev = int(a[len("--devices="):])
+        elif a == "--out" and i + 1 < len(argv):
+            out = argv[i + 1]
+        elif a.startswith("--out="):
+            out = a[len("--out="):]
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        f" --xla_force_host_platform_device_count={ndev}")
+    spec = _parse_synth_argv(argv) or \
+        {"rows": 1_000_000, "cols": 28, "chunk": 262144, "seed": 17}
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--multichip-worker",
+           "--synth=" + ",".join(f"{k}={v}" for k, v in spec.items())]
+    timeout_s = int(os.environ.get("BENCH_MC_TIMEOUT", 3600))
+    try:
+        proc = subprocess.run(cmd, env=env, capture_output=True,
+                              text=True, timeout=timeout_s)
+        rc, out_txt, err_txt = proc.returncode, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as exc:
+        rc = -1
+        out_txt = (exc.stdout or b"").decode() \
+            if isinstance(exc.stdout, bytes) else (exc.stdout or "")
+        err_txt = f"worker timed out after {timeout_s}s"
+    parsed = None
+    for line in reversed(out_txt.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+            break
+        except ValueError:
+            continue
+    record = {"n_devices": ndev, "rc": rc,
+              "ok": bool(rc == 0 and parsed
+                         and parsed.get("trees_per_sec", 0) > 0),
+              "skipped": False,
+              "tail": (err_txt or "")[-2000:] + (out_txt or "")[-500:]}
+    if parsed:
+        record.update(parsed)
+    with open(out, "w") as fh:
+        json.dump(record, fh, indent=2)
+        fh.write("\n")
+    print(json.dumps(record))
+    sys.stdout.flush()
+    return 0 if record["ok"] else 1
+
+
 def _compare_main(argv):
     """``bench.py --compare [--strict] [--trajectory-dir D]``: the bench
     regression sentinel (lightgbm_tpu/observability/regress.py) — check
@@ -638,6 +746,11 @@ def _report(result, block_times, block_trees, bench):
 if __name__ == "__main__":
     if "--compare" in sys.argv[1:]:
         sys.exit(_compare_main(sys.argv[1:]))
+    if "--multichip-worker" in sys.argv[1:]:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        sys.exit(_multichip_worker_main(sys.argv[1:]))
+    if "--multichip" in sys.argv[1:]:
+        sys.exit(_multichip_main(sys.argv[1:]))
     _result, _blocks, _bt, _bench = main()
     print(json.dumps(_result))
     sys.stdout.flush()

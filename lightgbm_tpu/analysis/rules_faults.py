@@ -36,6 +36,7 @@ DISPATCH_MANIFEST = (
     ("checkpoint.py", "save_checkpoint", "checkpoint_io"),
     ("loader.py", "_ingest_chunk_step", "streaming_ingest"),
     ("comm.py", "guarded_allgather", "collective_psum"),
+    ("hist_agg.py", "build_feature_shards", "distributed_hist_agg"),
 )
 
 #: wrapper function -> the site its body injects
@@ -44,6 +45,7 @@ SITE_WRAPPERS = {
     "check_collective_fault": "collective_psum",
     "_ingest_chunk_step": "streaming_ingest",
     "guarded_allgather": "collective_psum",
+    "check_hist_agg_fault": "distributed_hist_agg",
 }
 
 #: manifest basenames that are ambiguous in the package (engine.py
@@ -57,6 +59,7 @@ _DIR_HINTS = {
     ("gbdt.py", "_grow"): "boosting",
     ("loader.py", "_ingest_chunk_step"): "streaming",
     ("comm.py", "guarded_allgather"): "parallel",
+    ("hist_agg.py", "build_feature_shards"): "distributed",
 }
 
 

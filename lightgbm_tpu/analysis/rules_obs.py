@@ -66,6 +66,8 @@ DELEGATED_SITES = {
         ("gbdt.py", "boosting", "_grow"),
     ("loader.py", "_ingest_chunk_step"):
         ("loader.py", "streaming", "build_streamed_dataset"),
+    ("hist_agg.py", "reduce_scatter_hist"):
+        ("gbdt.py", "boosting", "_grow"),
 }
 
 

@@ -320,6 +320,12 @@ COLLECTIVE_MANIFEST = (
     ("histogram_mxu.py", "learner", "quantize_gradients",
      "collective_psum", "dispatch",
      ("test_distributed.py", "test_hist_backends.py")),
+    ("hist_agg.py", "distributed", "build_feature_shards",
+     "distributed_hist_agg", "body", ("test_distributed_learner.py",)),
+    ("hist_agg.py", "distributed", "reduce_scatter_hist",
+     "collective_psum", "dispatch", ("test_distributed_learner.py",)),
+    ("binning.py", "distributed", "merge_streaming_sketch",
+     "collective_psum", "delegate", ("test_distributed_learner.py",)),
 )
 
 

@@ -260,10 +260,18 @@ def _streaming_mapper_sync(cfg, cat):
     loader hands each rank's pass-1 sketch sample to this closure, which
     runs the same allgather the array path uses, so every rank freezes
     IDENTICAL bin boundaries before the collective histogram psum.
-    Returns None single-process (the loader then bins locally)."""
-    if _multihost_process_count() <= 1:
-        return None
-    return lambda sample: _allgather_find_mappers(sample, cfg, cat)
+    Returns None single-process (the loader then bins locally).
+
+    Resolution goes through `distributed.binning.distributed_mapper_sync`
+    (sketch telemetry + the documented distributed-binning entry point);
+    the fallback below keeps the delegate target explicit for the
+    collective manifest: the closure ultimately runs
+    `_allgather_find_mappers(sample, cfg, cat)` either way."""
+    from .distributed.binning import distributed_mapper_sync
+    sync = distributed_mapper_sync(cfg, cat)
+    if sync is None and _multihost_process_count() > 1:
+        return lambda sample: _allgather_find_mappers(sample, cfg, cat)
+    return sync
 
 
 class Dataset:

@@ -400,6 +400,7 @@ class GBDT:
         self.mesh = None
         self._grower = None
         self._row_pad = 0
+        self._bins_ft = None
         if cfg.tree_learner == "serial":
             return
         if cfg.num_machines > 1:
@@ -411,6 +412,7 @@ class GBDT:
             setup_multihost(cfg.num_machines, cfg.machines,
                             cfg.machine_list_filename,
                             cfg.local_listen_port)
+        _setup_t0 = time.time()
         ndev = cfg.num_devices if cfg.num_devices > 0 else len(jax.devices())
         ndev = min(ndev, len(jax.devices()))
         if ndev <= 1:
@@ -418,7 +420,8 @@ class GBDT:
                         "visible; falling back to serial", cfg.tree_learner)
             return
         from ..parallel import CommSpec, make_mesh
-        from ..parallel.learner import make_sharded_grower
+        from ..distributed.crossbar import (create_tree_learner,
+                                            resolve_learner)
         from jax.sharding import NamedSharding, PartitionSpec as P
         self._nproc = jax.process_count()
         if self._nproc > 1:
@@ -430,8 +433,22 @@ class GBDT:
                 "(rows pre-partitioned per machine, reference "
                 "dataset_loader.cpp:560-592); got %r" % cfg.tree_learner)
         self.mesh = make_mesh(ndev)
-        self.comm = CommSpec(axis="data", mode=cfg.tree_learner,
-                             num_devices=ndev, top_k=cfg.top_k)
+        # crossbar resolution (distributed/crossbar.py, the reference
+        # CreateTreeLearner factory): the MXU gate picks the device row,
+        # cfg.distributed_hist_agg the histogram-merge column — with the
+        # safety downgrades to psum applied in ONE place
+        excl = self._mxu_exclusions(cfg)
+        use_mxu = (cfg.use_pallas and jax.default_backend() != "cpu" and
+                   cfg.tree_learner == "data" and not excl)
+        spec = resolve_learner(
+            cfg.tree_learner, device="mxu" if use_mxu else "scatter",
+            hist_agg=cfg.distributed_hist_agg,
+            num_features=int(self.bins.shape[1]), top_k=cfg.top_k,
+            nproc=self._nproc, has_efb=self._efb is not None,
+            mono_rescan=self._mono_nonbasic)
+        self.comm = CommSpec(axis="data", mode=spec.mode,
+                             num_devices=ndev, top_k=cfg.top_k,
+                             hist_agg=spec.hist_agg)
         if self.comm.mode in ("data", "voting"):
             ndev_local = max(1, ndev // self._nproc)
             if self._nproc > 1:
@@ -455,16 +472,15 @@ class GBDT:
                 # metrics (reference ranks evaluate on their partition)
                 self._local_bins = self.bins
             self.bins = self._shard_rows(self.bins)
+            if self.comm.hist_agg == "reduce_scatter":
+                # one-time all_to_all feature-shard transpose: enables
+                # the exact reduce-scatter histogram flavor in grow_tree
+                from ..distributed.hist_agg import build_feature_shards
+                self._bins_ft = build_feature_shards(
+                    self.mesh, self.comm, self.bins)
         else:  # feature-parallel replicates rows (docs/Features.rst:109)
             self.bins = jax.device_put(
                 self.bins, NamedSharding(self.mesh, P()))
-        # the MXU growth path composes with data-parallel sharding
-        # (per-pass histogram psum); other modes and CPU keep the
-        # portable scatter grower (same _mxu_exclusions gate as the
-        # serial kernel choice)
-        excl = self._mxu_exclusions(cfg)
-        use_mxu = (cfg.use_pallas and jax.default_backend() != "cpu" and
-                   self.comm.mode == "data" and not excl)
         hard = [r for r in excl if r != "efb config"]
         if hard and cfg.use_pallas and jax.default_backend() != "cpu" \
                 and self.comm.mode == "data":
@@ -489,18 +505,18 @@ class GBDT:
             if rfu.shape[0] > 1:
                 rfu = self._shard_rows(rfu)
             self._cegb_state = (c, l, fu, rfu)
-        self._grower = make_sharded_grower(
-            self.mesh, self.comm, num_leaves=cfg.num_leaves,
+        self._grower = create_tree_learner(
+            spec, self.mesh, self.comm, num_leaves=cfg.num_leaves,
             max_depth=cfg.max_depth, hp=self.hp,
             leafwise=self._mono_nonbasic,
-            bmax=self.bmax, use_mxu=use_mxu, monotone=self._monotone,
+            bmax=self.bmax, monotone=self._monotone,
             monotone_method=self._mono_method,
             interaction_groups=self._interaction_groups,
             feature_fraction_bynode=cfg.feature_fraction_bynode,
             with_rng=self._sharded_rng,
             forced=self._forced, cegb_cfg=self._cegb_cfg,
             with_cegb_state=self._cegb_cfg is not None,
-            efb=self._efb,
+            efb=self._efb, with_bins_ft=self._bins_ft is not None,
             mxu_kwargs=dict(
                 hist_double_prec=cfg.gpu_use_dp,
                 tail_split_cap=cfg.tail_split_cap,
@@ -515,8 +531,14 @@ class GBDT:
                 # the fast path wrongly enabled and train silently
                 # wrong hessians)
                 const_hessian=0.0))
-        Log.info("Distributed learner: %s-parallel over %d devices%s",
-                 self.comm.mode, ndev, " (mxu)" if use_mxu else "")
+        Log.info("Distributed learner: %s-parallel over %d devices%s "
+                 "(hist_agg=%s)", self.comm.mode, ndev,
+                 " (mxu)" if use_mxu else "", self.comm.hist_agg)
+        _obs.record_distributed_setup(
+            world=ndev * max(1, self._nproc),
+            feature_shard_width=(int(self._bins_ft.shape[1]) // ndev
+                                 if self._bins_ft is not None else 0),
+            wall_seconds=time.time() - _setup_t0)
 
     def _shard_rows(self, arr):
         """Row-sharded global array over the mesh. Single-process: a
@@ -796,6 +818,8 @@ class GBDT:
                 jax.random.PRNGKey(cfg.extra_seed), self.iter_),)
         if self._cegb_cfg is not None:
             extra = extra + (self._cegb_state,)
+        if getattr(self, "_bins_ft", None) is not None:
+            extra = extra + (self._bins_ft,)
         with self.mesh:
             out = self._grower(
                 self.bins, g, h, cnt, feature_mask, self.num_bins_d,
@@ -1181,9 +1205,10 @@ class GBDT:
         cfg = self.config
         # guard rails need per-iteration host checks; the fused scan has
         # no host boundary to interpose on (docs/Reliability.md)
+        serial_ok = self._grower is None and self._hist_impl == "mxu"
         return (type(self) is GBDT and cfg.boosting in ("gbdt", "goss")
                 and cfg.guard_nonfinite == "off"
-                and self._grower is None and self._hist_impl == "mxu"
+                and (serial_ok or self._sharded_fused_ok())
                 and not self._linear
                 and self.objective is not None
                 and not self.objective.need_renew_tree_output
@@ -1194,6 +1219,24 @@ class GBDT:
         #       are replayed over each valid set AFTER the dispatch
         #       (_stacked_score_traj), reproducing the per-iteration
         #       score updates exactly
+
+    def _sharded_fused_ok(self) -> bool:
+        """Whether the distributed crossbar's data-parallel row can run
+        the fused multi-tree scan (distributed/fused.py): the boosting
+        loop moves inside shard_map, so the pipelined executor
+        double-buffers multi-device training exactly like the serial MXU
+        path. Single-host, single-class, plain gbdt on the portable
+        grower — GOSS (global top-k over all rows) and EFB/CEGB/rescan
+        monotone (per-iteration host state) stay per-iteration."""
+        cfg = self.config
+        return (self._grower is not None
+                and not getattr(self, "_sharded_mxu", False)
+                and getattr(self, "_nproc", 1) <= 1
+                and self.comm.mode == "data"
+                and cfg.boosting == "gbdt"
+                and self.num_tree_per_iteration == 1
+                and self._efb is None
+                and not self._mono_nonbasic)
 
     def _fused_sample_fn(self):
         """In-scan bagging/GOSS (fused.py contract): returns
@@ -1251,9 +1294,48 @@ class GBDT:
             return bag_fn, False
         return None, False
 
+    def _build_sharded_fused(self):
+        """Fused-scan builder for the sharded data-parallel grower
+        (distributed/fused.py) — the _build_fused analogue when the
+        crossbar resolved a row-sharded learner."""
+        from ..distributed.fused import build_sharded_fused_train
+        cfg = self.config
+        self._fused_needs_keys = False
+        bagging = None
+        if self._needs_bagging():
+            bagging = dict(
+                freq=cfg.bagging_freq, seed=cfg.bagging_seed,
+                fraction=cfg.bagging_fraction,
+                pos_fraction=cfg.pos_bagging_fraction,
+                neg_fraction=cfg.neg_bagging_fraction,
+                use_posneg=(cfg.pos_bagging_fraction < 1.0 or
+                            cfg.neg_bagging_fraction < 1.0))
+        # the exact static settings create_tree_learner bakes into the
+        # per-iteration sharded grower — same partial, same compiled
+        # growth body, so fused blocks match per-iteration training
+        grow_kwargs = dict(
+            num_leaves=cfg.num_leaves, max_depth=cfg.max_depth,
+            hp=self.hp, leafwise=self._mono_nonbasic, bmax=self.bmax,
+            monotone=self._monotone, monotone_method=self._mono_method,
+            interaction_groups=self._interaction_groups,
+            feature_fraction_bynode=cfg.feature_fraction_bynode,
+            forced=self._forced)
+        return build_sharded_fused_train(
+            mesh=self.mesh, comm=self.comm, objective=self.objective,
+            bins=self.bins, bins_ft=self._bins_ft,
+            num_data=self.num_data, row_pad=self._row_pad,
+            feature_mask_fn=self._feature_mask_at,
+            num_bins=self.num_bins_d,
+            missing_is_nan=self.missing_is_nan_d, is_cat=self.is_cat_d,
+            grow_kwargs=grow_kwargs, shrinkage=self.shrinkage_rate,
+            extra_seed=cfg.extra_seed, needs_rng=self._sharded_rng,
+            bagging=bagging)
+
     def _build_fused(self, debug: bool = False):
         from .fused import build_fused_train
         cfg = self.config
+        if self._grower is not None:
+            return self._build_sharded_fused()
         needs_rng = (cfg.feature_fraction_bynode < 1.0 or cfg.extra_trees
                      or cfg.use_quantized_grad)
         sample_fn, needs_keys = self._fused_sample_fn()

@@ -351,6 +351,16 @@ _PARAMS: List[ParamSpec] = [
     # ---- TPU-specific (new; no reference analog) ----
     _p("num_devices", int, 0, (),
        desc="devices in the mesh; 0 = use all visible"),
+    _p("distributed_hist_agg", str, "auto", (),
+       lambda v: v in ("auto", "psum", "reduce_scatter"),
+       "histogram merge for the data/voting tree learners: "
+       "'reduce_scatter' gives each device a feature shard of the global "
+       "histogram (the reference Reduce-Scatter, "
+       "data_parallel_tree_learner.cpp:184-233; O(S*F*B/world) memory "
+       "per device), 'psum' replicates the full histogram (the seed "
+       "Allreduce). 'auto' picks reduce_scatter wherever it is exact "
+       "(single-process data/voting without EFB or rescanning monotone "
+       "methods) and psum elsewhere; see distributed/crossbar.py"),
     _p("hist_dtype", str, "float32", (),
        lambda v: v in ("float32", "bfloat16"),
        "accumulation dtype for histograms"),

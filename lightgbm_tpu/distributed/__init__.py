@@ -1,0 +1,33 @@
+"""Distributed training subsystem: the learner crossbar, reduce-scatter
+histogram aggregation and distributed binning.
+
+The reference's distributed story lives in three places — the
+CreateTreeLearner factory (tree_learner.cpp:16-64), the parallel tree
+learners (data/feature/voting_parallel_tree_learner.cpp) and the network
+layer (src/network/). Here:
+
+- ``crossbar``: the learner-factory registry (device x parallelism)
+  that `boosting/gbdt.py` resolves a grower through, instead of
+  assuming the serial one.
+- ``hist_agg``: reduce-scatter histogram aggregation — each device owns
+  a contiguous feature shard of the global histogram, finds its best
+  local split, and a small allgather of [S, world] candidates merges
+  them (data_parallel_tree_learner.cpp:184-233; memory-efficient array
+  redistribution, arXiv:2112.01075).
+- ``binning``: per-rank streaming reservoir sketches merged through the
+  mapper-sync collective so bin mappers come from a global sample
+  without any host materializing the dataset (Histogram Sort with
+  Sampling, arXiv:1803.01237).
+- ``fused``: the row-sharded fused multi-tree scan — the boosting loop
+  of `boosting/fused.py` inside `shard_map`, so K sharded trees cost
+  one device dispatch and compose with the pipelined executor.
+"""
+
+from .crossbar import (CROSSBAR, LearnerSpec, create_tree_learner,
+                       resolve_learner)
+from .hist_agg import (build_feature_shards, check_hist_agg_fault,
+                       reduce_scatter_hist)
+
+__all__ = ["CROSSBAR", "LearnerSpec", "create_tree_learner",
+           "resolve_learner", "build_feature_shards",
+           "check_hist_agg_fault", "reduce_scatter_hist"]

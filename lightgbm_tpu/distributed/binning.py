@@ -1,0 +1,62 @@
+"""Distributed binning: per-rank streaming sketches -> global mappers.
+
+The streaming spine (PR 7) already computes a per-rank reservoir sketch
+during ingestion pass 1; multihost bin finding already rides ONE
+allgather (`basic.py::_allgather_find_mappers`, the reference's
+sample-then-allgather of dataset_loader.cpp:722-807). This module fuses
+the two into the distributed-binning entry point the streamed loader
+plugs in as its ``mapper_sync``: each rank contributes its reservoir
+sample, the fixed-wire-shape gather unions them, and every rank freezes
+IDENTICAL bin boundaries from a global sample — no host ever
+materializes (or even fully samples) the dataset (Histogram Sort with
+Sampling, arXiv:1803.01237).
+
+The sample allocation stays equal-per-rank
+(``bin_construct_sample_cnt // world`` rows each, exactly what
+`_allgather_find_mappers` gathers): byte parity with the in-memory
+multihost path is a checked invariant (tests/test_multihost.py) and the
+reference allocates the same way. The collective inherits the
+`collective_psum` fault site and the watchdog bracket from the
+delegated gather; this module adds the `lightgbm_tpu_distributed`
+sketch telemetry on top.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+__all__ = ["merge_streaming_sketch", "distributed_mapper_sync"]
+
+
+def merge_streaming_sketch(sample, cfg, cat):
+    """Merge this rank's pass-1 reservoir sketch into global bin
+    mappers: delegates the union to the mapper-sync allgather
+    (`_allgather_find_mappers` — fault site + watchdog bracket live
+    there), recording the sketch volume that crossed the wire into the
+    distributed metric family first."""
+    from ..basic import _allgather_find_mappers
+    rows = int(np.asarray(sample).shape[0]) if sample is not None else 0
+    _record_sketch(rows)
+    return _allgather_find_mappers(sample, cfg, cat)
+
+
+def distributed_mapper_sync(cfg, cat) -> Optional[Callable]:
+    """The streamed loader's multihost ``mapper_sync`` hook: a closure
+    mapping this rank's sketch sample to globally-agreed bin mappers.
+    None single-process — the loader then bins locally, and binning is
+    "distributed" over devices only (rows shard after binning)."""
+    from ..basic import _multihost_process_count
+    if _multihost_process_count() <= 1:
+        return None
+    return lambda sample: merge_streaming_sketch(sample, cfg, cat)
+
+
+def _record_sketch(rows: int) -> None:
+    """lightgbm_tpu_distributed sketch telemetry; never raises."""
+    try:
+        from ..observability.registry import registry
+        registry.record_distributed_sketch(rows)
+    except Exception:       # pragma: no cover - telemetry only
+        pass

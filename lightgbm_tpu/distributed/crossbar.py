@@ -1,0 +1,123 @@
+"""Learner-factory crossbar: device x parallelism -> grower.
+
+The reference resolves its tree learner through one factory,
+``TreeLearner::CreateTreeLearner`` (tree_learner.cpp:16-64): a crossbar
+of device type {cpu, gpu, cuda} x learner type {serial, feature, data,
+voting}. Our device column collapses to XLA (the same jitted growth
+body runs on CPU/TPU), but the crossbar survives as the single registry
+`boosting/gbdt.py` and the pipelined executor resolve a grower through
+— with two device rows of our own: the portable scatter grower and the
+MXU growth path, each crossed with the parallelism mode.
+
+``resolve_learner`` picks the row (validating mode/device/hist_agg
+combinations in ONE place instead of scattered gates);
+``create_tree_learner`` builds the actual shard_map'ped grower for it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+__all__ = ["LearnerSpec", "CROSSBAR", "resolve_learner",
+           "create_tree_learner"]
+
+
+@dataclasses.dataclass(frozen=True)
+class LearnerSpec:
+    """One crossbar cell: how tree growth is dispatched.
+
+    Mirrors the reference's (device, learner) template instantiation
+    (serial_tree_learner.cpp / *_parallel_tree_learner.cpp): `mode` is
+    the parallelism column, `device` the kernel row, `hist_agg` the
+    histogram merge algorithm for the row-sharded modes."""
+    mode: str                 # "serial" | "data" | "feature" | "voting"
+    device: str               # "scatter" (portable) | "mxu"
+    hist_agg: str = "psum"    # "psum" | "reduce_scatter" (data/voting)
+    rows_sharded: bool = False    # bins/grad/hess/cnt sharded over mesh
+    supports_multihost: bool = False
+
+    @property
+    def is_parallel(self) -> bool:
+        return self.mode != "serial"
+
+
+#: the factory table (reference tree_learner.cpp:16-64). Keys are
+#: (device, mode); values carry the sharding + merge contract of the
+#: cell. reduce_scatter rides only the portable data/voting rows: the
+#: MXU grower keeps its per-pass psum (its histogram lives inside the
+#: kernel), and feature-parallel has no histogram merge at all.
+CROSSBAR = {
+    ("scatter", "serial"): LearnerSpec("serial", "scatter"),
+    ("mxu", "serial"): LearnerSpec("serial", "mxu"),
+    ("scatter", "data"): LearnerSpec(
+        "data", "scatter", hist_agg="reduce_scatter", rows_sharded=True,
+        supports_multihost=True),
+    ("mxu", "data"): LearnerSpec(
+        "data", "mxu", hist_agg="psum", rows_sharded=True,
+        supports_multihost=True),
+    ("scatter", "feature"): LearnerSpec("feature", "scatter"),
+    ("scatter", "voting"): LearnerSpec(
+        "voting", "scatter", hist_agg="reduce_scatter",
+        rows_sharded=True),
+}
+
+
+def resolve_learner(tree_learner: str, *, device: str = "scatter",
+                    hist_agg: str = "auto", num_features: int = 0,
+                    top_k: int = 20, nproc: int = 1,
+                    has_efb: bool = False,
+                    mono_rescan: bool = False) -> LearnerSpec:
+    """Resolve one crossbar cell, downgrading `hist_agg` where the
+    reduce-scatter path cannot hold its contract:
+
+    - multihost (nproc > 1): the chaos/resume guarantees are proven on
+      the psum merge; gloo's all_to_all support is not, so cross-host
+      runs keep psum.
+    - EFB: histograms build in bundle space and expand per device; a
+      feature-sharded scan would need the expansion split mid-bundle.
+    - non-basic monotone methods: the whole-tree histogram cache wants
+      every feature on every device.
+    - voting with 2*top_k < F: the vote-selected columns are not a
+      contiguous block, so ownership does not cover them; classic
+      PV-Tree psum applies.
+
+    `hist_agg="auto"` means "reduce_scatter wherever exact", explicit
+    "psum"/"reduce_scatter" are honored (with the same safety
+    downgrades)."""
+    key = (device, tree_learner)
+    if key not in CROSSBAR:
+        raise ValueError(
+            f"no tree learner for device={device!r} "
+            f"tree_learner={tree_learner!r} (crossbar rows: "
+            f"{sorted(CROSSBAR)})")
+    spec = CROSSBAR[key]
+    agg = spec.hist_agg
+    if hist_agg != "auto":
+        agg = hist_agg
+    if agg == "reduce_scatter":
+        blocked = (nproc > 1 or has_efb or mono_rescan
+                   or device == "mxu"
+                   or spec.mode not in ("data", "voting")
+                   or (spec.mode == "voting"
+                       and num_features > 0
+                       and 2 * top_k < num_features))
+        if blocked:
+            agg = "psum"
+    if not spec.rows_sharded:
+        agg = "psum"    # no histogram merge happens at all
+    return dataclasses.replace(spec, hist_agg=agg)
+
+
+def create_tree_learner(spec: LearnerSpec, mesh, comm, **kwargs
+                        ) -> Optional[object]:
+    """Instantiate the grower for a resolved crossbar cell (the factory
+    half of CreateTreeLearner). Serial cells return None — the caller
+    keeps its un-shard_map'ped growth dispatch; parallel cells return
+    the jitted shard_map grower from parallel/learner.py with the
+    cell's device row selecting the MXU or portable body."""
+    if not spec.is_parallel:
+        return None
+    from ..parallel.learner import make_sharded_grower
+    return make_sharded_grower(mesh, comm, use_mxu=spec.device == "mxu",
+                               **kwargs)

@@ -235,7 +235,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # per-iteration metric/callback protocol from the block's valid-score
     # trajectory (GBDT.train_many). Results are identical to b=1: every
     # iteration is still evaluated, and an early stop mid-block rolls
-    # the extra trees back before propagating.
+    # the extra trees back before propagating. (Exception: the
+    # row-sharded fused path may carry 1-ulp score rounding vs b=1 —
+    # see distributed/fused.py; it is deterministic for any block size.)
     block = int(getattr(booster.config, "fused_block_size", 1) or 1)
     # after-callbacks must not read model state: at inner iteration j
     # the booster already holds the whole block's trees. The library's

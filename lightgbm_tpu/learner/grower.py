@@ -156,7 +156,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                      jax.Array]] = None,
               cegb_cfg: Optional[CegbParams] = None,
               cegb_state: Optional[Tuple[jax.Array, jax.Array, jax.Array]]
-              = None, monotone_method: str = "basic", efb=None):
+              = None, monotone_method: str = "basic", efb=None,
+              bins_ft: Optional[jax.Array] = None):
     """Grow one tree. grad/hess must already include bagging/objective
     weights (zeros for out-of-bag rows); `cnt_weight` is 1.0 for in-bag rows
     and 0.0 otherwise so min_data_in_leaf counts sampled rows only.
@@ -166,6 +167,17 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     features before the scan, and routing translates through the bundle
     tables — every other argument stays in original-feature space
     (reference feature_group.h:25; see efb.py).
+
+    With `comm.hist_agg == "reduce_scatter"` the data/voting histogram
+    merge switches from the full psum to the reference's Reduce-Scatter
+    (data_parallel_tree_learner.cpp:184-233): each device scans only its
+    feature block and a small [D, S] allgather merges the winners. When
+    `bins_ft` (the one-time all_to_all transpose from
+    distributed/hist_agg.py::build_feature_shards, [N_global, F/world]
+    per device) is supplied, the block histograms are built directly from
+    all rows — byte-identical to the serial learner; without it, local
+    full-width histograms fold through psum_scatter (numerically but not
+    bitwise equal).
 
     Returns (tree, row_node) — row_node maps every row (in- and out-of-bag)
     to its leaf for learner-side score updates (reference
@@ -208,6 +220,22 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 % (monotone_method, cache_bytes / 2**30, m + 1, f, bmax))
     k_top = num_leaves - 1             # static top-k size
     rows_sharded = comm is not None and comm.mode in ("data", "voting")
+    # Reduce-scatter histogram aggregation (distributed/hist_agg.py):
+    # device d owns the contiguous feature block [d*Fp, (d+1)*Fp). The
+    # exact flavor needs the bins_ft transpose; voting reduces to the
+    # exact data-parallel scan only when the top-2k vote selection covers
+    # every feature. EFB (bundle-space histograms) and the rescanning
+    # monotone methods (whole-tree full-width cache) keep the psum merge.
+    rs_mode = (rows_sharded and comm.hist_agg == "reduce_scatter"
+               and not mono_rescan and efb is None)
+    use_rs_exact = rs_mode and bins_ft is not None and (
+        comm.mode == "data" or 2 * comm.top_k >= f)
+    use_rs_scatter = rs_mode and not use_rs_exact and comm.mode == "data"
+    if use_rs_exact or use_rs_scatter:
+        ndev = comm.num_devices
+        fp = bins_ft.shape[1] if use_rs_exact else -(-f // ndev)
+        fpad = fp * ndev
+        myd = jax.lax.axis_index(comm.axis)
     if comm is not None and comm.mode == "feature":
         # deterministic round-robin feature shard (the reference balances by
         # total bin count, feature_parallel_tree_learner.cpp:38-57; round
@@ -217,14 +245,27 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             (jnp.arange(f, dtype=jnp.int32) % comm.num_devices) == my
         ).astype(feature_mask.dtype)
 
-    root_g = jnp.sum(grad)
-    root_h = jnp.sum(hess)
-    root_c = jnp.sum(cnt_weight)
-    if rows_sharded:
-        # root grad/hess sums allreduced (data_parallel_tree_learner.cpp:126)
-        root_g = jax.lax.psum(root_g, comm.axis)
-        root_h = jax.lax.psum(root_h, comm.axis)
-        root_c = jax.lax.psum(root_c, comm.axis)
+    if use_rs_exact:
+        # full-row gathers: with the feature-shard transpose this device
+        # histograms ALL rows of its features, so grad/hess/cnt (loop
+        # constants) gather once up front; summing the gathered arrays IS
+        # the serial root reduction — no psum, no blocked-sum skew
+        grad_full = jax.lax.all_gather(grad, comm.axis, tiled=True)
+        hess_full = jax.lax.all_gather(hess, comm.axis, tiled=True)
+        cnt_full = jax.lax.all_gather(cnt_weight, comm.axis, tiled=True)
+        root_g = jnp.sum(grad_full)
+        root_h = jnp.sum(hess_full)
+        root_c = jnp.sum(cnt_full)
+    else:
+        root_g = jnp.sum(grad)
+        root_h = jnp.sum(hess)
+        root_c = jnp.sum(cnt_weight)
+        if rows_sharded:
+            # root grad/hess sums allreduced
+            # (data_parallel_tree_learner.cpp:126)
+            root_g = jax.lax.psum(root_g, comm.axis)
+            root_h = jax.lax.psum(root_h, comm.axis)
+            root_c = jax.lax.psum(root_c, comm.axis)
     root_val = leaf_output(root_g, root_h, hp.lambda_l1, hp.lambda_l2,
                            hp.max_delta_step)
     w_cat = (bmax + 31) // 32          # bitset words per node
@@ -312,7 +353,20 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         tree = st.tree
         # ---- 1. histograms for frontier slots ----
         row_slot = st.slot_of_node[st.row_node]            # [N]
-        if hist_impl == "pallas":
+        if use_rs_exact:
+            # exact reduce-scatter: histogram ALL rows of THIS device's
+            # feature block from the bins_ft transpose — the identical
+            # scatter-adds the serial learner performs, restricted to a
+            # column block, so the block histogram is byte-equal to the
+            # serial one (per-feature accumulation is independent of how
+            # columns group into blocks)
+            row_slot_full = jax.lax.all_gather(row_slot, comm.axis,
+                                               tiled=True)
+            hist_sh = build_histograms(
+                bins_ft, grad_full, hess_full, row_slot_full, cnt_full,
+                num_slots=s, bmax=hist_bmax, feature_block=feature_block)
+            hist = None
+        elif hist_impl == "pallas":
             from .histogram_pallas import build_histograms_pallas
             hist = build_histograms_pallas(
                 bins, grad, hess, cnt_weight, row_slot, num_slots=s,
@@ -411,6 +465,76 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
         if comm is None or (mono_rescan and comm.mode == "data"):
             bs = scan_hist(hist, slot_fmask)  # cache already merged
+        elif use_rs_exact or use_rs_scatter:
+            # Reduce-Scatter scan (data_parallel_tree_learner.cpp:184-233):
+            # scan ONLY this device's feature block, then merge the [D, S]
+            # winners through a small allgather — the wire moves each
+            # histogram byte once instead of world times. The exact
+            # flavor's hist_sh is already the global block histogram; the
+            # scatter flavor folds full-width partials here.
+            if use_rs_exact:
+                # Scan at the SERIAL operand shape: the best-split prefix
+                # sum lowers to a GEMM whose rounding depends on the
+                # operand width ([S,Fp,B,C] vs [S,F,B,C] pick different
+                # kernel tilings), so a narrow block scan drifts from the
+                # serial scan by ulps. GEMM output rows are independent of
+                # each other, so embedding the block at its global column
+                # offset in a zero tensor of the serial shape makes the
+                # owned columns' results bit-equal to serial; the
+                # ownership mask hides the zero columns, and the argmax
+                # merge below ties to the lowest device = lowest feature
+                # id, matching the serial first-max tie-break.
+                full = jnp.zeros((hist_sh.shape[0], fpad) + hist_sh.shape[2:],
+                                 hist_sh.dtype)
+                full = jax.lax.dynamic_update_slice(
+                    full, hist_sh, (0, myd * fp, 0, 0))
+                own = ((jnp.arange(f) >= myd * fp) &
+                       (jnp.arange(f) < (myd + 1) * fp))
+                local = scan_hist(
+                    full[:, :f],
+                    slot_fmask * own[None, :].astype(slot_fmask.dtype))
+            else:
+                from ..distributed.hist_agg import reduce_scatter_hist
+                hist_sh = reduce_scatter_hist(
+                    jnp.pad(hist, ((0, 0), (0, fpad - f), (0, 0), (0, 0))),
+                    comm.axis)
+
+                def shard1(a, fill):
+                    pad = jnp.full(fpad - f, fill, a.dtype)
+                    return jax.lax.dynamic_slice_in_dim(
+                        jnp.concatenate([a, pad]), myd * fp, fp)
+
+                def shard2(a, fill):
+                    pad = jnp.full((a.shape[0], fpad - f), fill, a.dtype)
+                    return jax.lax.dynamic_slice_in_dim(
+                        jnp.concatenate([a, pad], axis=1), myd * fp, fp,
+                        axis=1)
+
+                # padded tail columns scan as masked-out single-bin
+                # features; block-local winner features translate back to
+                # global ids before the merge
+                mono_kw_sh = dict(
+                    monotone=(shard1(monotone, 0) if monotone is not None
+                              else None),
+                    cons_min=cons_min_s, cons_max=cons_max_s,
+                    depth=tree.depth[sn],
+                    rand_bins=(shard2(rand_bins, 0) if rand_bins is not None
+                               else None),
+                    gain_penalty=(shard2(gp, 0.0) if gp is not None
+                                  else None))
+                local = find_best_splits(
+                    hist_sh, tree.sum_grad[sn], tree.sum_hess[sn],
+                    tree.count[sn], tree.leaf_value[sn],
+                    shard1(num_bins, 1), shard1(missing_is_nan, False),
+                    shard1(is_cat_feat, False), shard2(slot_fmask, 0), hp,
+                    **mono_kw_sh)
+                local = local._replace(feature=jnp.where(
+                    local.feature >= 0, local.feature + myd * fp,
+                    local.feature))
+            gathered = BestSplits(*[
+                jax.lax.all_gather(getattr(local, fld), comm.axis)
+                for fld in BestSplits._fields])
+            bs = _merge_gathered_best(gathered)
         elif comm.mode == "data":
             # histogram merge == the ReduceScatter of
             # data_parallel_tree_learner.cpp:184-186; psum lets every device
@@ -463,11 +587,21 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             sp = jnp.clip(nf_slot, 0, n_spec - 1)
             ff = jnp.clip(forced_feat[sp], 0, f - 1)        # [S]
             fb = forced_bin[sp]
-            hsel = jnp.take_along_axis(
-                hist, ff[:, None, None, None], axis=1)[:, 0]  # [S, B, 3]
-            if rows_sharded and not mono_rescan:  # cache already merged
+            if use_rs_exact or use_rs_scatter:
+                # only the feature's owner holds its block histogram; the
+                # psum of the single nonzero contribution is an exact copy
+                owned = (ff >= myd * fp) & (ff < (myd + 1) * fp)
+                lff = jnp.clip(ff - myd * fp, 0, fp - 1)
+                hsel = jnp.take_along_axis(
+                    hist_sh, lff[:, None, None, None], axis=1)[:, 0]
+                hsel = hsel * owned[:, None, None].astype(hsel.dtype)
                 hsel = jax.lax.psum(hsel, comm.axis)
-            lmask = (jnp.arange(hist.shape[2])[None, :] <=
+            else:
+                hsel = jnp.take_along_axis(
+                    hist, ff[:, None, None, None], axis=1)[:, 0]  # [S,B,3]
+                if rows_sharded and not mono_rescan:  # cache merged
+                    hsel = jax.lax.psum(hsel, comm.axis)
+            lmask = (jnp.arange(hsel.shape[1])[None, :] <=
                      fb[:, None]).astype(hsel.dtype)
             lg = jnp.sum(hsel[..., 0] * lmask, axis=1)
             lh = jnp.sum(hsel[..., 1] * lmask, axis=1)

@@ -79,6 +79,12 @@ class ObservabilityRegistry:
                             "max_skew_s": 0.0}
         self._clock_samples: "collections.deque" = \
             _collections.deque(maxlen=512)
+        # distributed-training aggregates (distributed/): crossbar mesh
+        # setup (world size, reduce-scatter feature shard width) and the
+        # binning sketch volume merged through mapper_sync
+        self._distributed = {"world": 0, "feature_shard_width": 0,
+                             "setup_wall_seconds": 0.0,
+                             "sketch_rows": 0, "sketch_merges": 0}
         # shared singletons, NOT copies — existing call sites in
         # serving/, reliability/ and the phase timeits keep writing to
         # the same objects this registry reads.
@@ -142,6 +148,9 @@ class ObservabilityRegistry:
             self._clock_skew = {"samples": 0, "last_skew_s": 0.0,
                                 "max_skew_s": 0.0}
             self._clock_samples = _collections.deque(maxlen=512)
+            self._distributed = {"world": 0, "feature_shard_width": 0,
+                                 "setup_wall_seconds": 0.0,
+                                 "sketch_rows": 0, "sketch_merges": 0}
 
     # -- exporters ------------------------------------------------------
     def pipeline_snapshot(self) -> Dict:
@@ -186,6 +195,12 @@ class ObservabilityRegistry:
         c["heartbeat_age_max_s"] = round(c["heartbeat_age_max_s"], 3)
         return c
 
+    def distributed_snapshot(self) -> Dict:
+        with self._lock:
+            d = dict(self._distributed)
+        d["setup_wall_seconds"] = round(d["setup_wall_seconds"], 6)
+        return d
+
     def clock_skew_snapshot(self) -> Dict:
         with self._lock:
             s = dict(self._clock_skew)
@@ -205,6 +220,7 @@ class ObservabilityRegistry:
             "enabled": self.enabled,
             "clock_skew": self.clock_skew_snapshot(),
             "collective": self.collective_snapshot(),
+            "distributed": self.distributed_snapshot(),
             "flightrec": _flightrec.snapshot(),
             "profiler": _profiler.snapshot(),
             "hist_backend": self.hist_backend_snapshot(),
@@ -233,6 +249,7 @@ class ObservabilityRegistry:
             (snap["device_utilization"], "lightgbm_tpu_device", None),
             (snap["counters"], "lightgbm_tpu_reliability", None),
             (snap["collective"], "lightgbm_tpu_collective", None),
+            (snap["distributed"], "lightgbm_tpu_distributed", None),
             (snap["clock_skew"], "lightgbm_tpu_clock_skew", None),
             (snap["flightrec"], "lightgbm_tpu_flightrec", None),
             (snap["hist_backend"], "lightgbm_tpu_hist_backend", None),
@@ -436,6 +453,30 @@ class ObservabilityRegistry:
         with self._lock:
             self._streaming["sample_rows"] = int(sample_rows)
             self._streaming["exact"] = int(bool(exact))
+
+    def record_distributed_setup(self, world: int,
+                                 feature_shard_width: int,
+                                 wall_seconds: float) -> None:
+        """Crossbar mesh resolution (boosting/gbdt.py _setup_parallel):
+        device-mesh world size, the reduce-scatter feature shard width
+        (0 = psum full-histogram aggregation), and the setup wall."""
+        if not self.enabled:
+            return
+        with self._lock:
+            d = self._distributed
+            d["world"] = int(world)
+            d["feature_shard_width"] = int(feature_shard_width)
+            d["setup_wall_seconds"] += float(wall_seconds)
+
+    def record_distributed_sketch(self, rows: int) -> None:
+        """One per-rank sketch merged through the distributed-binning
+        mapper_sync (distributed/binning.py)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            d = self._distributed
+            d["sketch_rows"] += int(rows)
+            d["sketch_merges"] += 1
 
 
 #: process-global singleton; `lightgbm_tpu.observability.registry`.

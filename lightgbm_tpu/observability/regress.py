@@ -2,7 +2,9 @@
 
 Every round leaves a ``BENCH_r<N>.json`` (wrapped single-line bench
 record: {"n", "cmd", "rc", "tail", "parsed": {metric record}}), a
-``MULTICHIP_r<N>.json`` ({"n_devices", "rc", "ok", "skipped", "tail"})
+``MULTICHIP_r<N>.json`` ({"n_devices", "rc", "ok", "skipped", "tail"};
+real-training rounds add {"trees_per_sec", "vs_baseline",
+"tree_learner"} — bench.py --multichip)
 and — since the serving chaos PR — a ``SERVE_r<N>.json``
 (bench-record shape, emitted by bench_serve.py: sustained QPS at
 p99<10ms plus shed/fallback/failover side channels) in the repo root. Nothing ever read them back — a silent perf
@@ -99,6 +101,14 @@ def validate_record(kind: str, name: str, rec) -> List[str]:
         _need("rc", int)
         _need("ok", bool)
         _need("skipped", bool)
+        # real-training fields (bench.py --multichip, r06+): optional —
+        # dry-run rounds predate them — but typed when present
+        for key, types in (("trees_per_sec", (int, float)),
+                           ("vs_baseline", (int, float)),
+                           ("tree_learner", str)):
+            if key in rec and not isinstance(rec[key], types):
+                problems.append(f"{name}: {key!r} has type "
+                                f"{type(rec[key]).__name__}")
     else:
         problems.append(f"{name}: unknown record kind {kind!r}")
     return problems
@@ -125,6 +135,22 @@ def _bench_points(records) -> Dict[str, List[Tuple[int, float]]]:
     return series
 
 
+def _multichip_points(records) -> Dict[str, List[Tuple[int, float]]]:
+    """multichip metric series: rounds that measured real training
+    (bench.py --multichip writes trees_per_sec; dry-run rounds don't)
+    feed the same drop detector the bench series uses."""
+    series: Dict[str, List[Tuple[int, float]]] = {}
+    for rnd, _, rec in records:
+        if rec.get("rc", 1) != 0 or rec.get("skipped", False):
+            continue
+        for key in ("trees_per_sec", "vs_baseline"):
+            v = rec.get(key)
+            if isinstance(v, (int, float)) and v > 0:
+                series.setdefault(f"multichip_{key}", []) \
+                    .append((rnd, float(v)))
+    return series
+
+
 def compare(root: Optional[str] = None,
             threshold: float = REGRESSION_THRESHOLD) -> Dict:
     """The ``bench_regressions`` section: per-metric latest vs
@@ -140,6 +166,7 @@ def compare(root: Optional[str] = None,
     all_points = dict(_bench_points(traj["bench"]))
     for metric, pts in _bench_points(traj["serve"]).items():
         all_points[f"serve:{metric}"] = pts
+    all_points.update(_multichip_points(traj["multichip"]))
     for metric, points in sorted(all_points.items()):
         latest_rnd, latest = points[-1]
         earlier = points[:-1]

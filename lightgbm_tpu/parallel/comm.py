@@ -128,7 +128,18 @@ class CommSpec:
     mode: str = "data"            # "data" | "feature" | "voting"
     num_devices: int = 1
     top_k: int = 20               # voting-parallel top-k (config.top_k)
+    # histogram merge algorithm for the row-sharded modes:
+    # "psum" replicates the full [S, F, B, 3] histogram on every device
+    # (the seed behavior); "reduce_scatter" gives each device a
+    # contiguous feature shard of the global histogram and merges only
+    # [S]-sized split candidates (distributed/hist_agg.py — the
+    # reference's ReduceScatter of data_parallel_tree_learner.cpp:184).
+    hist_agg: str = "psum"
 
     def __post_init__(self):
         if self.mode not in ("data", "feature", "voting"):
             raise ValueError(f"unknown parallel mode {self.mode!r}")
+        if self.hist_agg not in ("psum", "reduce_scatter"):
+            raise ValueError(
+                f"unknown histogram aggregation {self.hist_agg!r} "
+                f"(expected 'psum' or 'reduce_scatter')")

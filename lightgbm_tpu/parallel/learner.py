@@ -64,7 +64,7 @@ def make_sharded_grower(mesh: Mesh, comm: CommSpec, *, num_leaves: int,
                         feature_fraction_bynode: float = 1.0,
                         with_rng: bool = False, forced=None,
                         cegb_cfg=None, with_cegb_state: bool = False,
-                        efb=None):
+                        efb=None, with_bins_ft: bool = False):
     """Build a shard_map'ped grower with the given static config.
 
     use_mxu (data-parallel only) runs the MXU grower inside shard_map
@@ -79,7 +79,12 @@ def make_sharded_grower(mesh: Mesh, comm: CommSpec, *, num_leaves: int,
     per-iteration key: every shard holds the identical key, samples the
     identical masks, and therefore takes identical split decisions — the
     reference syncs sampling seeds across machines the same way
-    (application.cpp:170-175 GlobalSyncUpByMin of seeds)."""
+    (application.cpp:170-175 GlobalSyncUpByMin of seeds).
+
+    with_bins_ft=True adds a trailing feature-sharded argument: the
+    [N_global, F/world] transpose from
+    distributed/hist_agg.py::build_feature_shards, enabling the exact
+    reduce-scatter histogram flavor inside grow_tree."""
     axis = comm.axis
     data_spec = P(axis) if comm.mode in ("data", "voting") else P()
 
@@ -117,6 +122,8 @@ def make_sharded_grower(mesh: Mesh, comm: CommSpec, *, num_leaves: int,
         rfu_spec = data_spec if (cegb_cfg is not None and
                                  cegb_cfg.has_lazy) else P()
         in_specs += ((P(), P(), P(), rfu_spec),)
+    if with_bins_ft:
+        in_specs += (P(None, axis),)
     out_specs = (P(), data_spec)
     if with_cegb_state:
         out_specs = (P(), data_spec, (P(), rfu_spec))
@@ -134,6 +141,8 @@ def make_sharded_grower(mesh: Mesh, comm: CommSpec, *, num_leaves: int,
             kw["rng_key"] = rest.pop(0)
         if with_cegb_state:
             kw["cegb_state"] = tuple(rest.pop(0))
+        if with_bins_ft:
+            kw["bins_ft"] = rest.pop(0)
         return grower(bins, grad, hess, cnt, feature_mask, num_bins,
                       missing_is_nan, is_cat, **kw)
 

@@ -57,6 +57,21 @@ def provision_virtual_devices(n_devices: int) -> None:
     except (AttributeError, KeyError):
         pass  # older jax without this config: XLA_FLAGS alone works pre-init
     jax.config.update("jax_platforms", "cpu")
+    # verify the provision actually took: when the initialized-backend
+    # detection above is unavailable (private API moved) and some
+    # harness touched JAX first, the config mutations silently miss the
+    # already-latched backend — the resulting single-device mesh errors
+    # would surface far away, in shard_map. Touching jax.devices() here
+    # latches the backend we just configured, which the very next call
+    # (make_mesh) does anyway.
+    got = len(jax.devices())
+    if got < n_devices:
+        raise RuntimeError(
+            f"provision_virtual_devices({n_devices}) had no effect: the "
+            f"JAX backend is up with {got} device(s). The CPU device "
+            f"count latches at first backend use — call "
+            f"provision_virtual_devices before any other JAX use "
+            f"(imports are fine; jax.devices()/jit/device_put are not).")
 
 
 def make_mesh(num_devices: int = 0, axis: str = "data") -> Mesh:

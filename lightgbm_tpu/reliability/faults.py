@@ -26,6 +26,8 @@ site                        raised from
 ``checkpoint_io``           reliability.checkpoint bundle writes
 ``streaming_ingest``        streaming.loader per-chunk ingest step (both
                             passes), before sketch/bin work on the chunk
+``distributed_hist_agg``    distributed.hist_agg.build_feature_shards,
+                            before the feature-shard all_to_all transpose
 ==========================  ==================================================
 
 All injection is host-side, at dispatch boundaries: raising inside
@@ -68,6 +70,7 @@ KNOWN_SITES = (
     "serving_hot_swap",
     "checkpoint_io",
     "streaming_ingest",
+    "distributed_hist_agg",
 )
 
 

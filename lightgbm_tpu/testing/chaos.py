@@ -97,6 +97,8 @@ CHAOS_WORKER = textwrap.dedent("""
                     callbacks=callbacks,
                     resume_from=ckpt_dir if resume else None)
     bst.save_model(os.environ["TEST_OUT"])
+    import jax
+    print("CHAOS_WORKER_DEVICES", jax.device_count())
     print("CHAOS_WORKER_DONE rank", rank)
 """)
 
@@ -106,9 +108,13 @@ def run_chaos_training(workdir: str, *, rounds: int,
                        timeout_s: float, death_rank: int = -1,
                        death_iter: int = -1, resume: bool = False,
                        harness_timeout: float = 420.0,
-                       out_prefix: str = "model") -> List[RankResult]:
+                       out_prefix: str = "model",
+                       devices_per_rank: int = 4) -> List[RankResult]:
     """Launch the 2-rank chaos worker; returns per-rank results. Model
-    files land at ``<workdir>/<out_prefix>_<rank>.txt``."""
+    files land at ``<workdir>/<out_prefix>_<rank>.txt``.
+    `devices_per_rank` sets each rank's virtual host-device count —
+    the default 2x4 geometry is the 8-device global mesh the
+    distributed acceptance scenario kills a rank out of."""
     from .subproc import repo_root
     os.makedirs(workdir, exist_ok=True)
     worker_py = os.path.join(workdir, "chaos_worker.py")
@@ -121,6 +127,8 @@ def run_chaos_training(workdir: str, *, rounds: int,
     for rank in range(2):
         envs.append(rank_env(
             rank,
+            XLA_FLAGS="--xla_force_host_platform_device_count=%d"
+                      % devices_per_rank,
             TEST_REPO=repo_root(),
             TEST_PORTS=",".join(ports),
             TEST_OUT=os.path.join(workdir, f"{out_prefix}_{rank}.txt"),

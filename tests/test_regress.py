@@ -154,6 +154,42 @@ def test_multichip_flip_is_a_regression(tmp_path):
         "samples"] == 2
 
 
+def test_multichip_throughput_drop_is_a_regression(tmp_path):
+    """r06+ MULTICHIP records carry real training throughput
+    (trees_per_sec / vs_baseline from the 8-device run); a >10% drop
+    vs best-so-far fires like any other tracked series, while legacy
+    dry-run records (no throughput fields) stay schema-valid and
+    contribute no samples."""
+    mc = {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+          "tree_learner": "data"}
+    _write(tmp_path, "MULTICHIP_r01.json", mc)    # legacy dry-run round
+    _write(tmp_path, "MULTICHIP_r02.json",
+           {**mc, "trees_per_sec": 40.0, "vs_baseline": 0.31})
+    _write(tmp_path, "MULTICHIP_r03.json",
+           {**mc, "trees_per_sec": 30.0, "vs_baseline": 0.23})  # -25%
+    for _, name, rec in regress.load_trajectory(
+            str(tmp_path))["multichip"]:
+        assert regress.validate_record("multichip", name, rec) == []
+    result = regress.compare(str(tmp_path))
+    metrics = {r["metric"] for r in result["regressions"]}
+    assert "multichip_trees_per_sec" in metrics
+    assert "multichip_vs_baseline" in metrics
+    entry = result["metrics"]["multichip_trees_per_sec"]
+    assert entry["best"] == 40.0 and entry["samples"] == 2
+
+
+def test_multichip_throughput_within_threshold_is_quiet(tmp_path):
+    mc = {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+          "tree_learner": "data"}
+    _write(tmp_path, "MULTICHIP_r01.json",
+           {**mc, "trees_per_sec": 40.0, "vs_baseline": 0.31})
+    _write(tmp_path, "MULTICHIP_r02.json",
+           {**mc, "trees_per_sec": 38.0, "vs_baseline": 0.29})  # -5%
+    result = regress.compare(str(tmp_path))
+    assert result["regressions"] == []
+    assert result["metrics"]["multichip_trees_per_sec"]["best"] == 40.0
+
+
 # ---------------------------------------------------------------------------
 # bench.py --compare wiring (subprocess: the real CLI path)
 
