@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-all faults chaos postmortem distributed observe lint lint-sarif pipeline kernels stream bench serve-chaos serve-bench install
+.PHONY: test test-slow test-all faults chaos postmortem distributed observe lint lint-sarif pipeline kernels perf stream bench serve-chaos serve-bench install
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -39,6 +39,16 @@ pipeline:
 kernels:
 	$(PY) -m pytest tests/ -x -q -m "kernels and not slow"
 	$(PY) -m pytest tests/ -x -q -m "kernels and slow"
+
+# the round-6 perf tier: microbench-shaped structural assertions for
+# the scan partition and the level-pipelined grower — stage/fixup
+# dispatch counts, speculative-overlap accounting, counts reuse,
+# sort-free jaxprs (tests/test_partition_scan.py,
+# tests/test_level_pipeline.py, docs/Performance.md "Level
+# pipelining"). Count-based, never wall-clock: green means the
+# structure that produced the BENCH_r06 numbers is intact
+perf:
+	$(PY) -m pytest tests/ -x -q -m "perf and not slow"
 
 # the out-of-core streaming tier: sketch/bin parity, adversarial chunk
 # layouts, model.txt byte-parity vs in-memory, mid-stream checkpoint

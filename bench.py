@@ -108,7 +108,19 @@ PARAMS = {"objective": "binary", "num_leaves": NUM_LEAVES,
           # backend, e.g. LGBM_TPU_HIST_BACKEND=mxu for the pre-kernel
           # attribution point (docs/Performance.md r06 protocol).
           "hist_backend": os.environ.get("LGBM_TPU_HIST_BACKEND",
-                                         "auto")}
+                                         "auto"),
+          # row partition for the slot-grouped scatter kernels: "auto"
+          # resolves to the blocked-prefix-sum scan (byte-identical to
+          # the argsort oracle). Pin LGBM_TPU_PARTITION_IMPL=argsort
+          # for the pre-scan attribution point of the r06 two-point
+          # protocol (docs/PerfNotes.md round 6).
+          "partition_impl": os.environ.get("LGBM_TPU_PARTITION_IMPL",
+                                           "auto")}
+if int(os.environ.get("BENCH_LEVEL_PIPELINE", "0")):
+    # staged level-pipelined grower (serial MXU path only; the fused
+    # multi-tree scan — the headline dispatch shape — ignores it).
+    # Opt-in so the default posture's parameter echo is unchanged.
+    PARAMS["level_pipeline"] = True
 # Bench posture vs library defaults (both A/B'd, docs/PerfNotes.md):
 # - use_quantized_grad: stochastically-rounded integer gradients with
 #   exact leaf refit. Round-3 A/B: 2.31 vs 1.74 trees/s, AUC@95
@@ -574,6 +586,12 @@ def main():
               # unknown, e.g. CPU or interpret mode)
               "achieved_tflops": 0.0, "mfu_per_tree": 0.0,
               "device_peak_tflops": 0.0,
+              # round-6 attribution side channels (never sentinel
+              # metrics): which partition impl ran, the staged-grower
+              # dispatch accounting, and — under BENCH_PROFILE_SPANS=1
+              # — per-span wall totals from the observability trace
+              "partition_impl": "", "level_pipeline": {},
+              "profile_spans": {},
               # per-task rows (regression/multiclass/lambdarank) from
               # helpers/bench_tasks.py, filled by _task_bench
               "tasks": [],
@@ -595,6 +613,14 @@ def main():
         import lightgbm_tpu as lgb
         from lightgbm_tpu import cext
         cext.available()  # lazy g++ build happens here, not in bin_time
+        if int(os.environ.get("BENCH_PROFILE_SPANS", "0")):
+            # span capture for the r06 attribution protocol: totals per
+            # span name ride the record. Opt-in — the ring appends cost
+            # real wall in the measured blocks, so headline runs leave
+            # it off (docs/Performance.md "BENCH_r06 attribution
+            # protocol")
+            from lightgbm_tpu.observability import registry as _obs0
+            _obs0.enable(ring=65536)
         X, y = make_higgs_like(N_ROWS, N_FEATURES)
         bench = _Bench(lgb, X, y)
         bench.rebuild()
@@ -668,6 +694,19 @@ def main():
         # recorded regardless of the observability enable flag
         from lightgbm_tpu.observability import registry as _obs
         result["hist_backend"] = _obs.hist_backend_snapshot()
+        result["partition_impl"] = str(PARAMS.get("partition_impl",
+                                                  "auto"))
+        result["level_pipeline"] = _obs.level_pipeline_snapshot()
+        if int(os.environ.get("BENCH_PROFILE_SPANS", "0")):
+            agg = {}
+            for sp in _obs.trace.spans():
+                a = agg.setdefault(sp["name"], [0, 0.0])
+                a[0] += 1
+                a[1] += sp["dur"]
+            result["profile_spans"] = {
+                name: {"count": c, "total_s": round(t, 4)}
+                for name, (c, t) in sorted(
+                    agg.items(), key=lambda kv: -kv[1][1])[:16]}
     except Exception as exc:
         print(f"# hist-backend record unavailable: {exc}",
               file=sys.stderr)

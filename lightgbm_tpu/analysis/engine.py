@@ -269,6 +269,7 @@ def all_rules() -> List[Rule]:
     from .rules_lock import LockDisciplineRule, LockOrderRule
     from .rules_obs import ObservabilityBracketRule
     from .rules_pallas import PallasKernelRule
+    from .rules_perf import PerfHotPathSortRule
     from .rules_registry import (CliTaskRoutingRule, ConfigAttrRule,
                                  FaultSiteRegistryRule, ParamDocsRule,
                                  PrometheusDocsRule)
@@ -280,7 +281,7 @@ def all_rules() -> List[Rule]:
         DtypeF64Rule(), DtypePromotionRule(),
         LockDisciplineRule(), LockOrderRule(),
         ObservabilityBracketRule(),
-        PallasKernelRule(),
+        PallasKernelRule(), PerfHotPathSortRule(),
         ParamDocsRule(), CliTaskRoutingRule(), ConfigAttrRule(),
         FaultSiteRegistryRule(), PrometheusDocsRule(),
         FaultCoverageRule(),

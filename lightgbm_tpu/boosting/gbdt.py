@@ -713,6 +713,7 @@ class GBDT:
             quantized_grad=cfg.use_quantized_grad,
             packed4=self._packed4,
             hist_backend=self._resolved_hist_backend(),
+            partition_impl=cfg.partition_impl,
             interpret=getattr(self, "_mxu_interpret", False))
 
     def _grow(self, g, h, cnt, feature_mask):
@@ -771,12 +772,25 @@ class GBDT:
             jax.random.PRNGKey(cfg.extra_seed), self.iter_) \
             if needs_rng else None
         if self._grower is None and self._hist_impl == "mxu":
-            from ..learner.grower_mxu import grow_tree_mxu
-            out = grow_tree_mxu(
-                self.bins, g, h, cnt, feature_mask, self.num_bins_d,
-                self.missing_is_nan_d, self.is_cat_d,
-                rng_key=rng_key, cegb_state=self._cegb_state,
-                **self._mxu_grow_kwargs())
+            if cfg.level_pipeline:
+                # staged per-level dispatch (byte-identical to the
+                # monolith; grower_pipeline.py falls back on its own
+                # ineligible configs)
+                from ..learner.grower_pipeline import grow_tree_pipelined
+                out = grow_tree_pipelined(
+                    self.bins, g, h, cnt, feature_mask, self.num_bins_d,
+                    self.missing_is_nan_d, self.is_cat_d,
+                    lookahead=cfg.level_pipeline_lookahead,
+                    iteration=self.iter_,
+                    rng_key=rng_key, cegb_state=self._cegb_state,
+                    **self._mxu_grow_kwargs())
+            else:
+                from ..learner.grower_mxu import grow_tree_mxu
+                out = grow_tree_mxu(
+                    self.bins, g, h, cnt, feature_mask, self.num_bins_d,
+                    self.missing_is_nan_d, self.is_cat_d,
+                    rng_key=rng_key, cegb_state=self._cegb_state,
+                    **self._mxu_grow_kwargs())
             if self._cegb_cfg is not None:
                 tree, row_node, (fu, rfu) = out
                 self._cegb_state = (self._cegb_state[0],
@@ -794,6 +808,7 @@ class GBDT:
                 interaction_groups=self._interaction_groups,
                 feature_fraction_bynode=cfg.feature_fraction_bynode,
                 rng_key=rng_key, hist_impl=self._hist_impl,
+                partition_impl=cfg.partition_impl,
                 forced=self._forced, cegb_cfg=self._cegb_cfg,
                 cegb_state=self._cegb_state,
                 monotone_method=self._mono_method, efb=self._efb)

@@ -139,7 +139,8 @@ def _merge_gathered_best(gathered: BestSplits) -> BestSplits:
     static_argnames=("num_leaves", "max_depth", "hp", "leafwise", "bmax",
                      "feature_block", "max_passes", "comm",
                      "interaction_groups", "feature_fraction_bynode",
-                     "hist_impl", "cegb_cfg", "monotone_method"))
+                     "hist_impl", "partition_impl", "cegb_cfg",
+                     "monotone_method"))
 def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               cnt_weight: jax.Array, feature_mask: jax.Array,
               num_bins: jax.Array, missing_is_nan: jax.Array,
@@ -152,6 +153,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               feature_fraction_bynode: float = 1.0,
               rng_key: Optional[jax.Array] = None,
               hist_impl: str = "scatter",
+              partition_impl: str = "auto",
               forced: Optional[Tuple[jax.Array, jax.Array, jax.Array,
                                      jax.Array]] = None,
               cegb_cfg: Optional[CegbParams] = None,
@@ -370,7 +372,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             from .histogram_pallas import build_histograms_pallas
             hist = build_histograms_pallas(
                 bins, grad, hess, cnt_weight, row_slot, num_slots=s,
-                bmax=hist_bmax)
+                bmax=hist_bmax, partition_impl=partition_impl)
         else:
             hist = build_histograms(bins, grad, hess, row_slot, cnt_weight,
                                     num_slots=s, bmax=hist_bmax,

@@ -1084,6 +1084,11 @@ def route_rows_mxu(bins: jax.Array, row_node: jax.Array, tbl: jax.Array,
     excluded), the exact metadata the scatter histogram's
     partition_rows needs, so routing stops being a count-only second
     pass. Returns (row_node, row_slot, counts) instead of 2-tuple.
+    Both partition implementations consume these counts: 'scan'
+    derives its exclusive prefix-sum slot bases from them directly
+    (routing + counting + partitioning = one sweep, no O(N log N)
+    sort), 'argsort' uses them only for the slot-base offsets while
+    re-deriving order via the stable sort (the bit-parity oracle).
     """
     n, fcols = bins.shape
     has_efb = loc_table is not None and not efb_range

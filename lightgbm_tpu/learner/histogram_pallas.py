@@ -9,10 +9,12 @@ row is multiplied against every live slot. This kernel removes the S
 factor:
 
 1. rows are partitioned by frontier slot ON DEVICE (partition_rows:
-   argsort of the row->slot vector, padded so every `row_block`
-   consecutive positions belong to ONE slot; the per-slot counts can
-   come straight from route_rows_mxu(emit_counts=True), making routing
-   + partition one pass over the binned matrix);
+   a blocked-prefix-sum stable rank of the row->slot vector — or the
+   retained argsort oracle, partition_impl= — padded so every
+   `row_block` consecutive positions belong to ONE slot; the per-slot
+   counts can come straight from route_rows_mxu(emit_counts=True),
+   making routing + counting + partition one sweep with no O(N log N)
+   sort);
 2. each grid step builds the block's (feature, bin) one-hots in VMEM
    and computes `data8 @ onehot` on the MXU — [8, row_block] x
    [row_block, G*B] per feature group, all channels in one dot. Cost is
@@ -53,8 +55,56 @@ __all__ = ["build_histograms_pallas", "build_histograms_scatter",
            "partition_rows"]
 
 
+#: rows per step of the scan partition's blocked cumsum (static; the
+#: per-step one-hot working set is _SCAN_CB x (num_slots+1) i32)
+_SCAN_CB = 4096
+
+
+def _stable_order_scan(slot_full: jax.Array, sort_start: jax.Array,
+                       num_slots: int) -> jax.Array:
+    """The stable argsort permutation WITHOUT sorting: O(N*S) blocked
+    prefix sums instead of the O(N log N) sort network.
+
+    A stable sort by slot places row i at
+        position[i] = sort_start[slot[i]] + rank[i]
+    where rank[i] = #{j < i : slot[j] == slot[i]} — the running
+    occurrence count of its slot. The rank comes from a blocked
+    exclusive cumsum: rows stream in _SCAN_CB-row blocks; each step
+    one-hots its block against the slot axis, takes the within-block
+    exclusive cumsum, and adds the carried per-slot totals of all
+    earlier blocks. Scattering arange(N) through `position` (a
+    permutation of [0, N), so the scatter is collision-free) inverts
+    it back into the order vector argsort would have produced —
+    bit-identical, which is what keeps the scan and argsort partitions
+    byte-equal downstream.
+    """
+    n = slot_full.shape[0]
+    s1 = num_slots + 1
+    cb = min(_SCAN_CB, max(n, 1))
+    npad = (-n) % cb
+    if npad:
+        # padded rows ride the trash slot AFTER every real row, so no
+        # real row's rank can count them
+        slot_full = jnp.pad(slot_full, (0, npad),
+                            constant_values=num_slots)
+    blocks = slot_full.reshape(-1, cb)
+    iota_s = jnp.arange(s1, dtype=jnp.int32)[None, :]
+
+    def step(base, slot_blk):
+        oh = (slot_blk[:, None] == iota_s).astype(jnp.int32)  # [cb, S+1]
+        excl = jnp.cumsum(oh, axis=0) - oh
+        rank_blk = base[slot_blk] + \
+            jnp.take_along_axis(excl, slot_blk[:, None], axis=1)[:, 0]
+        return base + jnp.sum(oh, axis=0), rank_blk
+
+    _, ranks = jax.lax.scan(step, jnp.zeros(s1, jnp.int32), blocks)
+    position = sort_start[slot_full] + ranks.reshape(-1)
+    return jnp.zeros(n, jnp.int32).at[position[:n]].set(
+        jnp.arange(n, dtype=jnp.int32))
+
+
 def partition_rows(row_slot: jax.Array, *, num_slots: int, row_block: int,
-                   counts: jax.Array = None):
+                   counts: jax.Array = None, impl: str = "auto"):
     """Device-side padded partition of rows by frontier slot.
 
     Every `row_block` consecutive positions of the returned layout hold
@@ -66,16 +116,23 @@ def partition_rows(row_slot: jax.Array, *, num_slots: int, row_block: int,
     segment_sum here, so routing + partition metadata is a single
     sweep over the rows.
 
+    impl selects how the slot-stable row permutation is produced:
+    "scan" (the "auto" resolution) computes the stable rank by blocked
+    prefix sums (_stable_order_scan — no O(N log N) sort), "argsort"
+    keeps the original stable sort as the bit-parity oracle. Both
+    yield the identical permutation, hence identical block layouts.
+
     Returns (block_slot [TB] i32, src [TB*row_block] i32): src indexes
     the original rows (n = dummy/padding position) and TB is the static
     block-count bound ceil(n/row_block) + num_slots + 1.
     """
+    if impl not in ("auto", "argsort", "scan"):
+        raise ValueError(f"unknown partition impl {impl!r}")
     n = row_slot.shape[0]
     s = num_slots
     nb = row_block
     slot_full = jnp.where((row_slot < 0) | (row_slot >= s), s,
                           row_slot).astype(jnp.int32)
-    order = jnp.argsort(slot_full)                        # [N]
     if counts is None:
         counts = jax.ops.segment_sum(jnp.ones(n, jnp.int32), slot_full,
                                      num_segments=s + 1)  # [S+1]
@@ -86,6 +143,12 @@ def partition_rows(row_slot: jax.Array, *, num_slots: int, row_block: int,
     sort_start = jnp.concatenate(
         [jnp.zeros(1, jnp.int32),
          jnp.cumsum(counts)[:-1].astype(jnp.int32)])
+    if impl == "argsort":
+        # the retained O(N log N) bit-parity oracle — the ONLY
+        # sanctioned sort on the partition path (PERF001)
+        order = jnp.argsort(slot_full)  # tpulint: disable=PERF001
+    else:
+        order = _stable_order_scan(slot_full, sort_start, s)
 
     # padded block layout: ceil(count/nb) blocks per slot, min 1
     caps = jnp.maximum(1, -(-counts // nb))
@@ -144,7 +207,7 @@ def _scatter_kernel(nb: int, f: int, b: int, fh: int = 0,
     jax.jit,
     static_argnames=("num_slots", "bmax", "row_block", "num_features",
                      "double_prec", "quantized", "const_hess",
-                     "interpret"))
+                     "partition_impl", "interpret"))
 def build_histograms_scatter(bins: jax.Array, grad: jax.Array,
                              hess: jax.Array, cnt: jax.Array,
                              row_slot: jax.Array, *, num_slots: int,
@@ -154,6 +217,7 @@ def build_histograms_scatter(bins: jax.Array, grad: jax.Array,
                              quantized: bool = False,
                              const_hess: float = 0.0,
                              slot_counts: jax.Array = None,
+                             partition_impl: str = "auto",
                              interpret: bool = False) -> jax.Array:
     """Per-slot histograms via the slot-grouped scatter kernel.
 
@@ -161,7 +225,8 @@ def build_histograms_scatter(bins: jax.Array, grad: jax.Array,
     slot. num_features > 0 marks `bins` as 4-bit packed
     (pack_bins_4bit) with that many logical features. slot_counts:
     optional per-slot row counts (route_rows_mxu emit_counts) so the
-    partition skips its own counting pass.
+    partition skips its own counting pass. partition_impl selects the
+    row-permutation scheme (partition_rows: auto|argsort|scan).
 
     Returns [num_slots, F, bmax, 3] f32 (grad, hess, count).
     """
@@ -174,7 +239,8 @@ def build_histograms_scatter(bins: jax.Array, grad: jax.Array,
     fb = f * b
 
     block_slot, src = partition_rows(row_slot, num_slots=s,
-                                     row_block=nb, counts=slot_counts)
+                                     row_block=nb, counts=slot_counts,
+                                     impl=partition_impl)
     tb_max = block_slot.shape[0]
 
     bins_ext = jnp.concatenate(
@@ -211,6 +277,7 @@ def build_histograms_pallas(bins: jax.Array, grad: jax.Array,
                             row_slot: jax.Array, *, num_slots: int,
                             bmax: int, row_block: int = 1024,
                             fchunk: int = 0,
+                            partition_impl: str = "auto",
                             interpret: bool = False) -> jax.Array:
     """Compat contract of the original one-hot kernel for the portable
     grower (grower.py hist_impl="pallas"): exact full-precision
@@ -219,4 +286,5 @@ def build_histograms_pallas(bins: jax.Array, grad: jax.Array,
     del fchunk
     return build_histograms_scatter(
         bins, grad, hess, cnt, row_slot, num_slots=num_slots, bmax=bmax,
-        row_block=row_block, interpret=interpret)
+        row_block=row_block, partition_impl=partition_impl,
+        interpret=interpret)

@@ -19,6 +19,17 @@ accelerator was unavailable have ``rc != 0`` / ``parsed: null`` /
 best-so-far — but an unusable LATEST round after any usable one is
 itself reported as a regression (the bench stopped working).
 
+A round may instead DECLARE denial: a bench/serve record with
+``"skipped": true`` and a ``"skip_reason"`` string (the multichip
+series has carried the same flag since r01). Skipped rounds are not
+samples and do not trip the unusable-latest rule — the distinction is
+intent: an rc=0/value=0 record says "the bench ran and measured
+nothing" (that IS a regression), a skipped record says "the operator
+established the hardware was unreachable and recorded why" (r06:
+wedged accelerator tunnel, probe timeout — the attribution evidence
+for such rounds lives in the record's side channels and
+docs/PerfNotes.md instead of the headline value).
+
 Wired into ``bench.py --compare [--strict]`` (strict: exit nonzero on
 regressions) and the ``make bench`` tail; tier-1 tests schema-validate
 the real records (tests/test_regress.py).
@@ -82,6 +93,12 @@ def validate_record(kind: str, name: str, rec) -> List[str]:
         _need("n", int)
         _need("rc", int)
         _need("cmd", str)
+        if "skipped" in rec:
+            _need("skipped", bool)
+            if rec.get("skipped") is True and not isinstance(
+                    rec.get("skip_reason"), str):
+                problems.append(f"{name}: skipped record needs a "
+                                f"'skip_reason' string")
         if "parsed" not in rec:
             problems.append(f"{name}: missing key 'parsed'")
         elif rec["parsed"] is not None:
@@ -121,7 +138,8 @@ def _bench_points(records) -> Dict[str, List[Tuple[int, float]]]:
     series: Dict[str, List[Tuple[int, float]]] = {}
     for rnd, _, rec in records:
         parsed = rec.get("parsed")
-        if rec.get("rc", 1) != 0 or not isinstance(parsed, dict):
+        if rec.get("skipped", False) or rec.get("rc", 1) != 0 or \
+                not isinstance(parsed, dict):
             continue
         metric = str(parsed.get("metric", "bench"))
         value = parsed.get("value")
@@ -192,6 +210,9 @@ def compare(root: Optional[str] = None,
             last_rnd, last_name, last = series[-1]
             usable_rounds = {r for pts in _bench_points(series).values()
                              for r, _ in pts}
+            if last.get("skipped", False):
+                # declared denial: not a sample, not a bench failure
+                continue
             if last_rnd not in usable_rounds:
                 regressions.append({
                     "metric": series_name, "latest_round": last_rnd,

@@ -120,6 +120,28 @@ def test_broken_latest_record_is_a_regression(tmp_path):
     assert reg["record"] == "BENCH_r02.json"
 
 
+def test_skipped_latest_round_is_declared_not_broken(tmp_path):
+    # a record carrying skipped=true + a reason (hardware denial, r06
+    # protocol) is not a sample and does not trip the unusable-latest
+    # rule — unlike an rc=0/value=0 record, which does
+    _write(tmp_path, "BENCH_r01.json", _bench_rec(2.0))
+    _write(tmp_path, "BENCH_r02.json",
+           {**_bench_rec(None), "skipped": True,
+            "skip_reason": "device probe timed out"})
+    result = regress.compare(str(tmp_path))
+    assert result["regressions"] == []
+    entry = result["metrics"]["higgs1m_trees_per_sec"]
+    assert entry["latest_round"] == 1 and entry["samples"] == 1
+
+
+def test_skipped_record_requires_a_reason(tmp_path):
+    rec = {**_bench_rec(None), "skipped": True}
+    problems = regress.validate_record("bench", "BENCH_r09.json", rec)
+    assert any("skip_reason" in p for p in problems)
+    rec["skip_reason"] = "wedged accelerator tunnel"
+    assert regress.validate_record("bench", "BENCH_r09.json", rec) == []
+
+
 def test_serve_series_regressions_flagged(tmp_path):
     """SERVE_r*.json rides the bench schema: a QPS drop beyond the
     threshold and a broken latest serve round both fire, under the

@@ -27,8 +27,8 @@ PACKAGE_DIR = os.path.dirname(os.path.abspath(lightgbm_tpu.__file__))
 ALL_RULE_IDS = (
     "COLL001", "COLL002", "COLL003", "COLL004",
     "DTYPE001", "DTYPE002", "FAULT001", "JIT001", "JIT002", "JIT003",
-    "JIT004", "LOCK001", "LOCK002", "OBS001", "PALLAS001", "REG001",
-    "REG002", "REG003", "REG004", "REG005", "SUP001",
+    "JIT004", "LOCK001", "LOCK002", "OBS001", "PALLAS001", "PERF001",
+    "REG001", "REG002", "REG003", "REG004", "REG005", "SUP001",
 )
 
 
@@ -129,6 +129,22 @@ def test_pallas_kernel_rule_fires():
     }
     # the static-factory + operand pattern (clean) must stay silent
     assert not any(f.line > 55 for f in findings)
+
+
+def test_perf_hot_path_rule_fires():
+    # manifest entry points (basename histogram_pallas.py) fire, the
+    # nested helper is covered by its enclosing entry, the host-side
+    # non-manifest function is exempt, and the oracle-shaped line
+    # suppression downgrades without hiding
+    findings = run_on("learner/histogram_pallas.py")
+    assert hits(findings) == {
+        ("PERF001", 12),   # partition_rows: direct argsort
+        ("PERF001", 18),   # build_histograms_scatter: nested sweep
+        ("PERF001", 30),   # build_histograms_pallas: suppressed oracle
+    }
+    assert {(f.line, f.suppressed) for f in findings} == {
+        (12, False), (18, False), (30, True)}
+    assert all(f.rule == "PERF001" for f in findings)
 
 
 def test_clean_fixture_is_silent():
