@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-all faults chaos postmortem distributed observe lint lint-sarif pipeline kernels perf stream bench serve-chaos serve-bench install
+.PHONY: test test-slow test-all faults chaos postmortem distributed observe lint lint-sarif pipeline kernels perf stream bench serve-chaos serve-bench loop loop-chaos install
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -95,6 +95,19 @@ distributed:
 serve-chaos:
 	$(PY) -m pytest tests/test_serve_chaos.py -x -q -m "serve_chaos and not slow"
 	$(PY) -m pytest tests/test_serve_chaos.py -x -q -m "serve_chaos and slow"
+
+# the continuous-loop tier (docs/Continuous.md): `loop` is the fast
+# state-machine/unit tier (tier-1); `loop-chaos` runs the slow
+# kill-matrix — one kill per fault site on the cycle path under live
+# traffic, plus poison quarantine and the freshness SLO alarm, with
+# byte-identity against an unkilled reference run
+# (tests/test_loop_chaos.py)
+loop:
+	$(PY) -m pytest tests/test_continuous.py -x -q -m "loop and not slow"
+
+loop-chaos:
+	$(PY) -m pytest tests/test_continuous.py -x -q -m "loop and not slow"
+	$(PY) -m pytest tests/test_loop_chaos.py -x -q -m "loop and slow"
 
 # the serving load bench: open-loop QPS ramp + chaos stage, emits
 # SERVE_r<N>.json (sustained QPS at p99<10ms) into the same

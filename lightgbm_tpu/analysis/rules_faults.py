@@ -33,8 +33,10 @@ DISPATCH_MANIFEST = (
     ("engine.py", "predict_raw", "serving_device_predict"),
     ("replicas.py", "dispatch", "serving_replica_predict"),
     ("server.py", "hot_swap", "serving_hot_swap"),
+    ("server.py", "hot_swap", "serving_hot_swap_commit"),
     ("checkpoint.py", "save_checkpoint", "checkpoint_io"),
     ("loader.py", "_ingest_chunk_step", "streaming_ingest"),
+    ("trainer.py", "_publish", "loop_publish"),
     ("comm.py", "guarded_allgather", "collective_psum"),
     ("hist_agg.py", "build_feature_shards", "distributed_hist_agg"),
 )
@@ -58,6 +60,7 @@ _DIR_HINTS = {
     ("gbdt.py", "train_many_dispatch"): "boosting",
     ("gbdt.py", "_grow"): "boosting",
     ("loader.py", "_ingest_chunk_step"): "streaming",
+    ("trainer.py", "_publish"): "continuous",
     ("comm.py", "guarded_allgather"): "parallel",
     ("hist_agg.py", "build_feature_shards"): "distributed",
 }

@@ -146,6 +146,8 @@ class Application:
             self.save_binary()
         elif task == "serve":
             self.serve()
+        elif task == "loop":
+            self.loop()
         else:
             Log.fatal("Unknown task %s", task)
 
@@ -387,6 +389,65 @@ class Application:
                  cfg.output_result, metrics_path)
         if cfg.observe and cfg.observe_trace_file:
             from .observability import registry as _obs
+            fmt = _obs.dump_trace(cfg.observe_trace_file)
+            Log.info("Wrote %s span trace to %s", fmt,
+                     cfg.observe_trace_file)
+
+    def loop(self) -> None:
+        """task=loop: the continuous train -> refresh -> serve loop
+        (docs/Continuous.md).
+
+        Windows of `loop_window_chunks` stream chunks are pulled from
+        `data`, each refresh continues boosting from the live model,
+        and every new generation is checkpointed under `loop_dir` and
+        hot-swapped into a serving entry under live traffic. The loop
+        is kill-survivable at every seam: rerunning the same conf
+        resumes from the GENERATION marker."""
+        import json
+        cfg = self.config
+        if not cfg.data:
+            Log.fatal("No streaming data: set data=<file>")
+        if not cfg.loop_dir:
+            Log.fatal("No loop state dir: set loop_dir=<dir>")
+        from .continuous import ContinuousTrainer
+        from .serving import Server
+        from .streaming import source_from_path
+        if cfg.label_column.startswith("name:"):
+            Log.fatal("label_column=name: requires header parsing; "
+                      "use index")
+        source = source_from_path(cfg.data,
+                                  chunk_rows=cfg.stream_chunk_rows,
+                                  label_col=cfg.label_column or 0,
+                                  header=cfg.header)
+        with Server.from_config(cfg) as server:
+            if cfg.observe:
+                from .observability import registry as _obs
+                _obs.enable(ring=cfg.observe_ring)
+                msrv = server.start_metrics_server(
+                    port=cfg.observe_metrics_port)
+                Log.info("observability metrics at %s", msrv.url)
+            trainer = ContinuousTrainer(cfg, source, server,
+                                        params=dict(self.params))
+            published = trainer.run()
+            snapshot = server.metrics_snapshot()
+        if trainer._live_model_str is not None:
+            with open_file(cfg.output_model, "w") as fh:
+                fh.write(trainer._live_model_str)
+        from .observability import registry as _obs
+        fresh = _obs.freshness_snapshot()
+        snapshot["freshness"] = fresh
+        metrics_path = cfg.serve_metrics_file or \
+            cfg.output_model + ".metrics.json"
+        with open_file(metrics_path, "w") as fh:
+            json.dump(snapshot, fh, indent=2)
+            fh.write("\n")
+        Log.info("Finished loop: %d generations published (live "
+                 "generation %d, %d quarantined windows, last "
+                 "data-to-serve %.3fs), model saved to %s, metrics "
+                 "to %s", published, fresh["generation"],
+                 fresh["quarantined_windows"], fresh["data_to_serve_s"],
+                 cfg.output_model, metrics_path)
+        if cfg.observe and cfg.observe_trace_file:
             fmt = _obs.dump_trace(cfg.observe_trace_file)
             Log.info("Wrote %s span trace to %s", fmt,
                      cfg.observe_trace_file)

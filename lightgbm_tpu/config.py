@@ -42,7 +42,8 @@ _PARAMS: List[ParamSpec] = [
        # "prediction"/"test" are reference-CLI spellings of "predict"
        # (application.cpp:85); cli.Application.run routes all three
        lambda v: v in ("train", "predict", "prediction", "test",
-                       "convert_model", "refit", "save_binary", "serve")),
+                       "convert_model", "refit", "save_binary", "serve",
+                       "loop")),
     _p("objective", str, "regression",
        ("objective_type", "app", "application", "loss")),
     _p("boosting", str, "gbdt",
@@ -546,6 +547,46 @@ _PARAMS: List[ParamSpec] = [
             "the reservoir sample did not cover every row (i.e. "
             "stream_sample_rows < N), instead of silently accepting "
             "sample-based boundaries"),
+    # ---- Continuous train->refresh->serve loop (docs/Continuous.md) ----
+    _p("loop_dir", str, "", ("loop_state_dir",),
+       desc="state root of task=loop (continuous/trainer.py): the "
+            "GENERATION marker, the gens/ bundle history, the work/ "
+            "per-cycle scratch (stream state + mid-train checkpoints) "
+            "and the postmortems/ flight-recorder bundles all live "
+            "under it. Required for task=loop — the loop's whole "
+            "crash-survivability story is this directory"),
+    _p("loop_rounds", int, 10, ("loop_num_iterations",), lambda v: v >= 1,
+       "boosting iterations added per refresh cycle (the per-window "
+       "continuation budget, NOT a total)"),
+    _p("loop_window_chunks", int, 1, (), lambda v: v >= 1,
+       "stream chunks consumed per refresh window: each cycle trains on "
+       "WindowSource(base, cursor, loop_window_chunks) and advances the "
+       "cursor by that many chunks on publish"),
+    _p("loop_windows", int, 0, (), lambda v: v >= 0,
+       "maximum refresh cycles before the loop exits (0 = run until the "
+       "source is exhausted)"),
+    _p("loop_keep", int, 3, (), lambda v: v >= 1,
+       "generation bundles retained under <loop_dir>/gens; the bundle "
+       "the live generation was published from is pinned and survives "
+       "this quota (reliability/checkpoint.py pin_bundle)"),
+    _p("loop_poison_retries", int, 3, (), lambda v: v >= 1,
+       "crash-loop budget per window: a window whose cycle fails this "
+       "many consecutive attempts is quarantined — skipped, logged, "
+       "counted in lightgbm_tpu_freshness_quarantined_windows — instead "
+       "of wedging the loop forever"),
+    _p("loop_backoff_ms", float, 50.0, (), lambda v: v >= 0,
+       "base of the capped exponential backoff between failed cycle "
+       "attempts (reliability/backoff.py); 0 disables the sleep"),
+    _p("loop_backoff_max_ms", float, 2000.0, (), lambda v: v >= 0,
+       "cap of the inter-attempt backoff"),
+    _p("loop_freshness_slo_s", float, 0.0, (), lambda v: v >= 0,
+       "staleness budget for the freshness watchdog: when the "
+       "data-to-serving latency of a publish exceeds it, the "
+       "lightgbm_tpu_freshness_slo_alarm gauge latches 1 (0 disables "
+       "the alarm; the latency metric itself is always recorded)"),
+    _p("loop_model_name", str, "live", (),
+       desc="registry name the loop publishes refreshed generations "
+            "under (Server.load_model first, Server.hot_swap after)"),
 ]
 
 _SPEC_BY_NAME: Dict[str, ParamSpec] = {p.name: p for p in _PARAMS}

@@ -1,0 +1,19 @@
+"""Continuous train -> refresh -> serve loop (docs/Continuous.md).
+
+`ContinuousTrainer` drives the full lifecycle under one durable state
+machine: pull the next window of fresh rows through the streaming
+spine, continue boosting from the live model, cut a generation
+checkpoint, and atomically publish it into the serving registry under
+live traffic. Every seam is a named fault site and every kill is
+survivable — mid-ingest resumes from stream state, mid-train resumes
+from the last checkpoint bundle, mid-publish leaves the old generation
+serving while the torn half-built one is detected via the GENERATION
+marker and discarded. Windows that crash-loop past the retry budget
+are quarantined instead of wedging the loop, and data-to-serving
+latency is exported as the ``lightgbm_tpu_freshness`` metric family
+with an SLO alarm.
+"""
+
+from .trainer import ContinuousTrainer, CYCLE_TAG, MARKER
+
+__all__ = ["ContinuousTrainer", "CYCLE_TAG", "MARKER"]

@@ -130,7 +130,22 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     "init_model continuation needs raw data on the "
                     "datasets; pass free_raw_data=False or un-constructed "
                     "Datasets")
-            init = base_model.predict(ds.data, raw_score=True)
+            if isinstance(ds.data, ChunkSource):
+                # continued boosting over a streamed dataset (the
+                # continuous loop's refresh path): the raw matrix never
+                # materializes host-side, so seed init scores chunk by
+                # chunk through a fresh pass of the restartable source
+                # — row order matches the loader's pass-2 binning order
+                parts = [base_model.predict(X, raw_score=True)
+                         for X, _ in ds.data.chunks()]
+                if not parts:
+                    raise ValueError(
+                        "init_model continuation over an exhausted "
+                        "stream: the source yielded no chunks to seed "
+                        "init scores from")
+                init = np.concatenate(parts, axis=0)
+            else:
+                init = base_model.predict(ds.data, raw_score=True)
             ds.init_score = init
             ds._seeded_init_score = True
             if ds._binned is not None:

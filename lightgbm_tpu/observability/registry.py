@@ -93,6 +93,16 @@ class ObservabilityRegistry:
         self._distributed = {"world": 0, "feature_shard_width": 0,
                              "setup_wall_seconds": 0.0,
                              "sketch_rows": 0, "sketch_merges": 0}
+        # continuous-loop freshness watchdog (continuous/trainer.py):
+        # data-to-serving latency of the live generation plus the loop's
+        # incident counters — torn publishes discarded on recovery and
+        # poison windows quarantined after crash-looping
+        self._freshness = {"generation": 0, "publishes": 0,
+                           "data_to_serve_s": 0.0,
+                           "max_data_to_serve_s": 0.0,
+                           "staleness_slo_s": 0.0, "slo_alarm": 0,
+                           "slo_breaches": 0, "torn_publishes": 0,
+                           "quarantined_windows": 0}
         # shared singletons, NOT copies — existing call sites in
         # serving/, reliability/ and the phase timeits keep writing to
         # the same objects this registry reads.
@@ -163,6 +173,12 @@ class ObservabilityRegistry:
             self._distributed = {"world": 0, "feature_shard_width": 0,
                                  "setup_wall_seconds": 0.0,
                                  "sketch_rows": 0, "sketch_merges": 0}
+            self._freshness = {"generation": 0, "publishes": 0,
+                               "data_to_serve_s": 0.0,
+                               "max_data_to_serve_s": 0.0,
+                               "staleness_slo_s": 0.0, "slo_alarm": 0,
+                               "slo_breaches": 0, "torn_publishes": 0,
+                               "quarantined_windows": 0}
 
     # -- exporters ------------------------------------------------------
     def level_pipeline_snapshot(self) -> Dict:
@@ -226,6 +242,13 @@ class ObservabilityRegistry:
         d["setup_wall_seconds"] = round(d["setup_wall_seconds"], 6)
         return d
 
+    def freshness_snapshot(self) -> Dict:
+        with self._lock:
+            f = dict(self._freshness)
+        f["data_to_serve_s"] = round(f["data_to_serve_s"], 6)
+        f["max_data_to_serve_s"] = round(f["max_data_to_serve_s"], 6)
+        return f
+
     def clock_skew_snapshot(self) -> Dict:
         with self._lock:
             s = dict(self._clock_skew)
@@ -246,6 +269,7 @@ class ObservabilityRegistry:
             "clock_skew": self.clock_skew_snapshot(),
             "collective": self.collective_snapshot(),
             "distributed": self.distributed_snapshot(),
+            "freshness": self.freshness_snapshot(),
             "flightrec": _flightrec.snapshot(),
             "profiler": _profiler.snapshot(),
             "hist_backend": self.hist_backend_snapshot(),
@@ -276,6 +300,7 @@ class ObservabilityRegistry:
             (snap["counters"], "lightgbm_tpu_reliability", None),
             (snap["collective"], "lightgbm_tpu_collective", None),
             (snap["distributed"], "lightgbm_tpu_distributed", None),
+            (snap["freshness"], "lightgbm_tpu_freshness", None),
             (snap["clock_skew"], "lightgbm_tpu_clock_skew", None),
             (snap["flightrec"], "lightgbm_tpu_flightrec", None),
             (snap["hist_backend"], "lightgbm_tpu_hist_backend", None),
@@ -346,6 +371,54 @@ class ObservabilityRegistry:
                 self._clock_skew["max_skew_s"], skew)
             self._clock_samples.append({"site": str(site), "walls": w})
         _flightrec.record_clock_sample(site, w)
+
+    # -- continuous-loop hooks (continuous/trainer.py) ------------------
+    # recorded even when disabled, like the watchdog hooks: the
+    # freshness SLO alarm and the loop's incident counters (torn
+    # publishes, quarantines) are the forensics the chaos protocol
+    # reads from metrics alone — they must not depend on an enable flag
+    def record_freshness_publish(self, generation: int,
+                                 data_to_serve_s: float,
+                                 slo_s: float = 0.0) -> None:
+        """One published generation: `data_to_serve_s` is the wall from
+        first row of the window entering ingest to the hot-swap landing
+        (data-to-serving latency). `slo_s` > 0 arms the staleness
+        alarm: the gauge latches 1 whenever the latest publish blew the
+        budget and clears on the next in-budget one."""
+        lat = float(data_to_serve_s)
+        breach = int(slo_s > 0 and lat > float(slo_s))
+        with self._lock:
+            f = self._freshness
+            f["generation"] = int(generation)
+            f["publishes"] += 1
+            f["data_to_serve_s"] = lat
+            f["max_data_to_serve_s"] = max(f["max_data_to_serve_s"], lat)
+            f["staleness_slo_s"] = float(slo_s)
+            f["slo_alarm"] = breach
+            f["slo_breaches"] += breach
+
+    def record_freshness_recover(self, generation: int) -> None:
+        """Loop recovery re-read the GENERATION marker: seed the live
+        generation gauge so a restarted process that publishes nothing
+        (exhausted stream, serve-only restart) still reports the
+        generation it is actually serving, not 0. Publish counters are
+        untouched — only publishes move them."""
+        with self._lock:
+            f = self._freshness
+            f["generation"] = max(f["generation"], int(generation))
+
+    def record_freshness_torn_publish(self, generation: int) -> None:
+        """A half-built generation found ahead of the marker on
+        recovery — the torn-publish twin of streaming's torn
+        stream-state pairs — detected and discarded."""
+        with self._lock:
+            self._freshness["torn_publishes"] += 1
+
+    def record_freshness_quarantine(self, window: int) -> None:
+        """A poison window skipped after crash-looping the cycle past
+        its retry budget."""
+        with self._lock:
+            self._freshness["quarantined_windows"] += 1
 
     def tree_macs_for(self, gbdt) -> int:
         """Analytic per-tree MAC estimate for this booster's config;

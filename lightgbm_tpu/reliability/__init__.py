@@ -28,7 +28,8 @@ from .faults import (FaultRegistry, InjectedFault, KNOWN_SITES,
 from .guards import GUARD_POLICIES, GuardError
 from .retry import retry_call
 from .checkpoint import (CheckpointState, latest_checkpoint,
-                         load_checkpoint, save_checkpoint)
+                         load_checkpoint, pin_bundle, pinned_bundle,
+                         save_checkpoint)
 from .watchdog import (CollectiveGuard, WATCHDOG_EXIT_CODE, active_guard,
                        collective_guard, configure_watchdog,
                        maybe_start_watchdog, shutdown_watchdog)
@@ -40,7 +41,7 @@ __all__ = [
     "GUARD_POLICIES", "GuardError",
     "retry_call",
     "CheckpointState", "latest_checkpoint", "load_checkpoint",
-    "save_checkpoint",
+    "pin_bundle", "pinned_bundle", "save_checkpoint",
     "CollectiveGuard", "WATCHDOG_EXIT_CODE", "active_guard",
     "collective_guard", "configure_watchdog", "maybe_start_watchdog",
     "shutdown_watchdog",

@@ -1,0 +1,41 @@
+"""Capped-exponential backoff policy for cross-attempt crash loops.
+
+`retry.retry_call` owns the in-call retry ladder (one function, one
+attempt budget, sleeps inline). The continuous loop needs the same
+curve but OUTSIDE a single call: a cycle that crash-loops is retried
+across full recover/rebuild attempts, and the attempt counter lives in
+the driver, not in a wrapper frame. This policy object is that curve —
+deterministic (no jitter, same as retry.py, so chaos tests can assert
+exact delays) and injectable (`sleep=` stub for tests).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+__all__ = ["BackoffPolicy"]
+
+
+class BackoffPolicy:
+    """delay(attempt) = min(base_ms * 2**attempt, max_ms), attempt 0-based."""
+
+    def __init__(self, base_ms: float = 50.0, max_ms: float = 2000.0,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.base_ms = float(base_ms)
+        self.max_ms = float(max_ms)
+        self._sleep = sleep
+
+    def delay_ms(self, attempt: int) -> float:
+        if self.base_ms <= 0:
+            return 0.0
+        return min(self.base_ms * (2.0 ** max(0, int(attempt))),
+                   self.max_ms)
+
+    def wait(self, attempt: int) -> float:
+        """Sleep the capped delay for `attempt`; returns the delay (ms)
+        actually slept so callers can log/record it."""
+        delay = self.delay_ms(attempt)
+        if delay > 0:
+            self._sleep(delay / 1e3)
+        return delay

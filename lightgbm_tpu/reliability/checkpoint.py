@@ -52,7 +52,8 @@ from .counters import counters
 from .faults import faults
 
 __all__ = ["CheckpointState", "save_checkpoint", "load_checkpoint",
-           "latest_checkpoint", "FORMAT_VERSION", "COMMIT_MARKER"]
+           "latest_checkpoint", "FORMAT_VERSION", "COMMIT_MARKER",
+           "PIN_FILE", "pin_bundle", "pinned_bundle"]
 
 FORMAT_VERSION = 1
 
@@ -62,6 +63,10 @@ _LATEST = "LATEST"
 #: commit point of the coordinated save protocol; bundles that declare
 #: world_size > 1 in state.json but lack it are partial and ignored
 COMMIT_MARKER = "COMMIT"
+#: top-level file naming the bundle the serving registry's live
+#: generation was published from; `_prune` never deletes it, no matter
+#: how far `keep_last` has advanced past it (pin-by-generation)
+PIN_FILE = "PINNED"
 
 
 @dataclass
@@ -258,12 +263,48 @@ def _save_coordinated(ckpt_dir: str, iteration: int, model_str: str,
     return final
 
 
+def pin_bundle(ckpt_dir: str, bundle: Optional[str]) -> None:
+    """Mark `bundle` (a path or bare bundle name) as the one the
+    serving registry's live generation was published from. `_prune`
+    skips it regardless of `keep_last`, so a slow consumer of an old
+    generation can never find its bytes gone. Pass None to unpin.
+    Written via os.replace (the LATEST idiom) so readers never see a
+    torn pin."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    pin = os.path.join(ckpt_dir, PIN_FILE)
+    if bundle is None:
+        try:
+            os.unlink(pin)
+        except FileNotFoundError:
+            pass
+        return
+    tmp = pin + f".tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(os.path.basename(bundle) + "\n")
+    os.replace(tmp, pin)
+
+
+def pinned_bundle(ckpt_dir: str) -> Optional[int]:
+    """Iteration of the pinned bundle, or None. A vanished or garbled
+    pin file reads as unpinned — same ENOENT discipline as `_listdir`:
+    a killed publisher may have left nothing, and that must not wedge
+    pruning."""
+    try:
+        with open(os.path.join(ckpt_dir, PIN_FILE)) as f:
+            return _bundle_iter(f.read().strip())
+    except OSError:
+        return None
+
+
 def _prune(ckpt_dir: str, keep_last: int) -> None:
     """Keep the newest `keep_last` COMPLETE bundles. Incomplete
     (uncommitted) bundles never count toward the quota — and any
     incomplete bundle older than the newest complete one is a stale
-    torn write from a killed run, removed as garbage. Every removal
+    torn write from a killed run, removed as garbage. The bundle named
+    by the PIN_FILE (the serving registry's live generation) is never
+    removed, even when it has aged out of the quota. Every removal
     tolerates a concurrent rank racing us to it."""
+    pinned = pinned_bundle(ckpt_dir)
     complete: List[int] = []
     stale: List[int] = []
     for name in _listdir(ckpt_dir):
@@ -276,12 +317,14 @@ def _prune(ckpt_dir: str, keep_last: int) -> None:
             stale.append(it)
     complete.sort()
     for it in complete[:-keep_last]:
+        if it == pinned:
+            continue
         shutil.rmtree(os.path.join(ckpt_dir, _bundle_name(it)),
                       ignore_errors=True)
     if complete:
         newest = complete[-1]
         for it in stale:
-            if it < newest:
+            if it < newest and it != pinned:
                 shutil.rmtree(os.path.join(ckpt_dir, _bundle_name(it)),
                               ignore_errors=True)
 

@@ -23,11 +23,17 @@ site                        raised from
 ``serving_replica_predict`` serving ReplicaSet.dispatch, per-replica device
                             attempt (drives breaker open/failover)
 ``serving_hot_swap``        serving Server.hot_swap, before the registry swap
+``serving_hot_swap_commit`` serving Server.hot_swap, after the atomic publish
+                            but before the old batcher drains — the other
+                            side of the swap's commit point
 ``checkpoint_io``           reliability.checkpoint bundle writes
 ``streaming_ingest``        streaming.loader per-chunk ingest step (both
                             passes), before sketch/bin work on the chunk
 ``distributed_hist_agg``    distributed.hist_agg.build_feature_shards,
                             before the feature-shard all_to_all transpose
+``loop_publish``            continuous.ContinuousTrainer._publish, after the
+                            serving swap but before the generation marker
+                            advances (torn-publish window)
 ==========================  ==================================================
 
 All injection is host-side, at dispatch boundaries: raising inside
@@ -68,9 +74,11 @@ KNOWN_SITES = (
     "serving_device_predict",
     "serving_replica_predict",
     "serving_hot_swap",
+    "serving_hot_swap_commit",
     "checkpoint_io",
     "streaming_ingest",
     "distributed_hist_agg",
+    "loop_publish",
 )
 
 

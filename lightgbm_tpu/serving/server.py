@@ -182,6 +182,11 @@ class Server:
             entry, prev = self.registry._load_prepared(
                 name, booster=booster, model_file=model_file,
                 model_str=model_str)
+            # registered fault site, the other side of the commit
+            # point: the NEW entry is already published, so a kill here
+            # must leave the new model serving with the old batcher's
+            # queue drained by the recovery path, never a torn registry
+            faults.inject("serving_hot_swap_commit")
             drained = self.registry._drain_replaced(prev)
         Log.info(f"serving: hot-swapped '{name}' to v{entry.version} "
                  f"({drained} queued requests drained via host)")

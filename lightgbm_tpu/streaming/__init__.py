@@ -18,10 +18,10 @@ byte-identical to the in-memory path.
 from .loader import StreamStats, build_streamed_dataset
 from .sketch import ReservoirSketch
 from .sources import (ArraySource, ChunkSource, CSVSource, NpySource,
-                      ParquetSource, source_from_path)
+                      ParquetSource, WindowSource, source_from_path)
 
 __all__ = [
     "ArraySource", "ChunkSource", "CSVSource", "NpySource",
-    "ParquetSource", "ReservoirSketch", "StreamStats",
+    "ParquetSource", "ReservoirSketch", "StreamStats", "WindowSource",
     "build_streamed_dataset", "source_from_path",
 ]

@@ -25,7 +25,7 @@ import numpy as np
 from ..utils.file_io import open_file
 
 __all__ = ["ChunkSource", "ArraySource", "CSVSource", "NpySource",
-           "ParquetSource", "source_from_path"]
+           "ParquetSource", "WindowSource", "source_from_path"]
 
 #: a pass yields (X_chunk [n, F] ndarray, y_chunk [n] or None)
 Chunk = Tuple[np.ndarray, Optional[np.ndarray]]
@@ -105,6 +105,61 @@ class NpySource(ArraySource):
 
     def describe(self) -> str:
         return f"npy:{os.path.basename(self.path)}[{self.num_rows}]"
+
+
+class WindowSource(ChunkSource):
+    """A bounded window of `window_chunks` chunks over a base source,
+    starting at base chunk `start_chunk` — the continuous loop's unit
+    of refresh (continuous/trainer.py). The window is itself a full
+    `ChunkSource`: restartable (`chunks(start_chunk=k)` re-opens the
+    base at `start_chunk + k`, so mid-stream checkpoint resume replays
+    within the window), and a window over an array-backed source stays
+    a zero-copy `.array` view. A window past the end of the base yields
+    no chunks — the loop's exhaustion probe — and a base that ends
+    mid-window yields a clean partial pass, never a torn one."""
+
+    def __init__(self, base: "ChunkSource", start_chunk: int = 0,
+                 window_chunks: int = 1):
+        super().__init__(base.chunk_rows)
+        if start_chunk < 0:
+            raise ValueError("start_chunk must be >= 0")
+        if window_chunks < 1:
+            raise ValueError("window_chunks must be >= 1")
+        self.base = base
+        self.start_chunk = int(start_chunk)
+        self.window_chunks = int(window_chunks)
+        self.has_label = base.has_label
+        self.num_features = base.num_features
+        if base.array is not None:
+            lo = self.start_chunk * base.chunk_rows
+            hi = lo + self.window_chunks * base.chunk_rows
+            self.array = base.array[lo:hi]
+            self.num_rows = int(self.array.shape[0])
+        elif base.num_rows is not None:
+            lo = min(self.start_chunk * base.chunk_rows, base.num_rows)
+            hi = min(lo + self.window_chunks * base.chunk_rows,
+                     base.num_rows)
+            self.num_rows = hi - lo
+
+    def chunks(self, start_chunk: int = 0) -> Iterator[Chunk]:
+        budget = self.window_chunks - start_chunk
+        rows = 0
+        if budget > 0:
+            for X, y in self.base.chunks(self.start_chunk + start_chunk):
+                if self.num_features is None:
+                    self.num_features = int(X.shape[1])
+                rows += int(X.shape[0])
+                yield X, y
+                budget -= 1
+                if budget == 0:
+                    break
+        if start_chunk == 0 and self.num_rows is None:
+            self.num_rows = rows
+
+    def describe(self) -> str:
+        return (f"window[{self.start_chunk}:"
+                f"{self.start_chunk + self.window_chunks}] of "
+                f"{self.base.describe()}")
 
 
 class CSVSource(ChunkSource):

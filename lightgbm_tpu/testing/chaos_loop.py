@@ -1,0 +1,259 @@
+"""Continuous-loop chaos harness: kill the loop at every seam, prove
+nothing breaks (docs/Continuous.md, "Chaos protocol").
+
+What tests/test_loop_chaos.py drives:
+
+1. a **dyadic publish transform** — every generation's model text is
+   rewritten so leaf values are multiples of 2^-10 with bounded
+   magnitude (chaos_serve.dyadic_booster's trick, applied per
+   generation and idempotent under re-application), so served raw
+   scores are *bit-identical* to host `Booster.predict` and "the
+   survivor answered from a real generation" is `np.array_equal`
+   against the per-generation reference predictions, not a tolerance;
+2. a **reference run** — the same stream, config and seed with no
+   faults armed, recording every published generation's model text;
+3. **kill scenarios** — one per fault site on the cycle's path
+   (`streaming_ingest`, `histogram_build`, `checkpoint_io`,
+   `serving_hot_swap`, `serving_hot_swap_commit`, `loop_publish`):
+   the site is armed mid-loop while closed-loop traffic hammers the
+   served entry, the cycle dies, the trainer's recovery path rebuilds
+   it, and the outcome must show zero dropped requests, every answer
+   bit-identical to SOME published generation, every published
+   generation byte-identical to the reference run's, and a flushed
+   flight-recorder postmortem per failed cycle;
+4. **poison + freshness** — a window whose every rebuild attempt dies
+   is quarantined (visible from the freshness metric family alone),
+   and a sub-nanosecond `loop_freshness_slo_s` raises the SLO alarm
+   gauge without any other observable change.
+
+The "kill" model is `InjectedFault` propagating out of the cycle: the
+trainer's `run` catches it, flushes a postmortem, and re-enters
+`_recover` — the exact code path a freshly restarted process runs, so
+in-process crash-loops exercise restart recovery without fork cost.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from .chaos_serve import _LEAF_LINE, _quantize, LoadResult, run_closed_loop
+
+__all__ = ["DEFAULT_TRAIN_PARAMS", "dyadic_model_transform",
+           "write_stream_csv", "loop_params", "make_loop",
+           "collect_generation_models", "verify_survivor_answers",
+           "LoopChaosOutcome", "run_loop_scenario"]
+
+#: deterministic small-model params: every rebuild of a killed cycle
+#: must reproduce the reference bytes, so nothing here may depend on
+#: wall clock, thread count or accumulated RNG state
+DEFAULT_TRAIN_PARAMS = {
+    "objective": "regression",
+    "num_leaves": 7,
+    "min_data_in_leaf": 5,
+    "verbosity": -1,
+    "boost_from_average": False,
+    "deterministic": True,
+    "seed": 3,
+}
+
+
+def dyadic_model_transform(model_str: str) -> str:
+    """Quantize every leaf value to a multiple of 2^-10 with |v| <= 8.
+
+    Idempotent by construction (a dyadic rational re-quantizes to
+    itself), which the loop requires: a recovered cycle re-applies the
+    transform to a model whose base trees were already transformed."""
+    def _requantize(m):
+        return m.group(1) + " ".join(_quantize(v)
+                                     for v in m.group(2).split())
+    return _LEAF_LINE.sub(_requantize, model_str)
+
+
+def write_stream_csv(path: str, *, chunks: int = 6, chunk_rows: int = 48,
+                     f: int = 6, seed: int = 11) -> np.ndarray:
+    """Write a label-in-column-0 CSV stream of `chunks * chunk_rows`
+    rows; returns the feature matrix (the serving probe pool). A text
+    source (not an array view) keeps BOTH loader passes live, so
+    `streaming_ingest` kills exercise real stream-state resume."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(chunks * chunk_rows, f)
+    y = X[:, 0] * 1.5 - 0.7 * X[:, 1] + 0.3 * rng.randn(len(X))
+    np.savetxt(path, np.column_stack([y, X]), delimiter=",",
+               fmt="%.10g")
+    return X
+
+
+def loop_params(loop_dir: str, **overrides) -> Dict:
+    """Train + loop params for one scenario. `loop_backoff_ms=0`
+    keeps crash-loop retries instant (the policy still runs, the clock
+    is just flat); chaos tests that assert the curve stub the sleep."""
+    p = dict(DEFAULT_TRAIN_PARAMS)
+    p.update({
+        "loop_dir": loop_dir,
+        "loop_rounds": 3,
+        "loop_window_chunks": 2,
+        "loop_keep": 100,        # retain every generation: the byte-
+                                 # identity sweep reads them all back
+        "loop_poison_retries": 3,
+        "loop_backoff_ms": 0.0,
+        "loop_freshness_slo_s": 0.0,
+        "loop_model_name": "live",
+    })
+    p.update(overrides)
+    return p
+
+
+def make_loop(data_path: str, params: Dict, *, chunk_rows: int = 48,
+              publish_transform: Optional[Callable] =
+              dyadic_model_transform):
+    """Build (trainer, server, config) for one scenario. The caller
+    owns the server's lifetime (use `with server:` or close it)."""
+    from ..config import Config
+    from ..continuous import ContinuousTrainer
+    from ..serving import Server
+    from ..streaming import source_from_path
+    cfg = Config(dict(params))
+    server = Server.from_config(cfg)
+    source = source_from_path(data_path, chunk_rows=chunk_rows,
+                              label_col=0)
+    trainer = ContinuousTrainer(cfg, source, server,
+                                params=dict(params),
+                                publish_transform=publish_transform,
+                                sleep=lambda s: None)
+    return trainer, server, cfg
+
+
+def collect_generation_models(loop_dir: str) -> Dict[int, str]:
+    """generation -> model text, read back from the gens bundles."""
+    gens_dir = os.path.join(loop_dir, "gens")
+    out: Dict[int, str] = {}
+    from ..reliability.checkpoint import _bundle_iter
+    try:
+        names = os.listdir(gens_dir)
+    except OSError:
+        return out
+    for name in names:
+        it = _bundle_iter(name)
+        if it is None:
+            continue
+        try:
+            with open(os.path.join(gens_dir, name, "model.txt")) as fh:
+                out[it] = fh.read()
+        except OSError:
+            continue
+    return out
+
+
+def verify_survivor_answers(load: LoadResult, gen_models: Dict[int, str],
+                            X: np.ndarray) -> int:
+    """Every 'ok' answer must be bit-identical to the host predict of
+    the same rows under SOME published generation — a torn or
+    half-swapped model matches none of them. Returns the number of
+    records checked; raises AssertionError on the first orphan."""
+    from ..basic import Booster
+    refs = []
+    for gen in sorted(gen_models):
+        bst = Booster(model_str=gen_models[gen])
+        refs.append((gen, bst.predict(X, raw_score=True)))
+    assert refs, "no generations were published; nothing to verify"
+    checked = 0
+    for rec in load.ok_records():
+        got = np.asarray(rec.value)
+        if not any(np.array_equal(got, ref[rec.lo:rec.hi])
+                   for _, ref in refs):
+            raise AssertionError(
+                f"request {rec.idx} rows [{rec.lo},{rec.hi}) matches "
+                f"no published generation {sorted(gen_models)} bit-"
+                f"for-bit — a torn model answered it")
+        checked += 1
+    return checked
+
+
+# ----------------------------------------------------------------------
+@dataclass
+class LoopChaosOutcome:
+    """Everything one kill scenario asserts on, in one record."""
+    published: int                    # generations published post-boot
+    bootstrap_published: int
+    load: Optional[LoadResult]
+    gen_models: Dict[int, str] = field(default_factory=dict)
+    final_model: Optional[str] = None
+    freshness: Dict = field(default_factory=dict)
+    cycle_failures: int = 0           # loop_cycle_failures delta
+    trips: int = 0                    # fault firings at the armed site
+    quarantined: List[int] = field(default_factory=list)
+    postmortems: List[str] = field(default_factory=list)
+
+
+def _postmortem_files(loop_dir: str) -> List[str]:
+    out = []
+    root = os.path.join(loop_dir, "postmortems")
+    for dirpath, _dirs, names in os.walk(root):
+        out.extend(os.path.join(dirpath, n) for n in names
+                   if n.startswith("postmortem_"))
+    return sorted(out)
+
+
+def run_loop_scenario(data_path: str, loop_dir: str, probe_X: np.ndarray,
+                      *, windows: int, site: Optional[str] = None,
+                      fail: int = 1, skip: int = 0, bootstrap: int = 1,
+                      n_requests: int = 0, traffic_workers: int = 3,
+                      chunk_rows: int = 48,
+                      params_overrides: Optional[Dict] = None,
+                      ) -> LoopChaosOutcome:
+    """Run one kill scenario: bootstrap `bootstrap` windows clean (so
+    the serving entry exists), arm `site` with a skip/fail schedule,
+    then run the remaining windows — under closed-loop traffic when
+    `n_requests` > 0 (the loop runs in a helper thread while the
+    traffic ledger fills in the caller's)."""
+    from ..observability import registry as _obs
+    from ..reliability import counters
+    from ..reliability.faults import faults
+    params = loop_params(loop_dir, **(params_overrides or {}))
+    trainer, server, cfg = make_loop(data_path, params,
+                                     chunk_rows=chunk_rows)
+    failures0 = counters.get("loop_cycle_failures")
+    trips0 = faults.trips(site) if site else 0
+    with server:
+        boot = trainer.run(max_windows=bootstrap) if bootstrap else 0
+        if site is not None:
+            faults.schedule(site, fail=fail, skip=skip)
+        try:
+            load = None
+            remaining = windows - bootstrap
+            if n_requests > 0:
+                published_box = []
+                th = threading.Thread(
+                    target=lambda: published_box.append(
+                        trainer.run(max_windows=remaining)),
+                    daemon=True)
+                th.start()
+                load = run_closed_loop(
+                    server, cfg.loop_model_name, probe_X,
+                    n_requests=n_requests, workers=traffic_workers,
+                    max_rows=32, raw_score=True, seed=5)
+                th.join(timeout=300)
+                assert not th.is_alive(), "loop thread wedged"
+                published = published_box[0] if published_box else 0
+            else:
+                published = trainer.run(max_windows=remaining)
+        finally:
+            if site is not None:
+                faults.schedule(site, fail=0, skip=0)
+    return LoopChaosOutcome(
+        published=published,
+        bootstrap_published=boot,
+        load=load,
+        gen_models=collect_generation_models(loop_dir),
+        final_model=trainer._live_model_str,
+        freshness=_obs.freshness_snapshot(),
+        cycle_failures=counters.get("loop_cycle_failures") - failures0,
+        trips=(faults.trips(site) - trips0) if site else 0,
+        quarantined=list(trainer.quarantined),
+        postmortems=_postmortem_files(loop_dir),
+    )

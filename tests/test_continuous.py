@@ -1,0 +1,431 @@
+"""Continuous train -> refresh -> serve loop: fast tier (docs/Continuous.md).
+
+Unit + small-integration coverage of the loop's parts: `WindowSource`
+semantics (windowing, exhaustion, clean partial windows, restart
+within a window), the crash-loop `BackoffPolicy`, pin-by-generation
+checkpoint retention, the `lightgbm_tpu_freshness` metric family,
+torn-publish detection, poison-window quarantine bookkeeping,
+mid-publish kills (`serving_hot_swap` / `serving_hot_swap_commit` /
+`loop_publish`) with the survivor's answers pinned to a real
+generation, streamed init_model seeding, and the task=loop CLI. The
+full kill-matrix with live traffic is tests/test_loop_chaos.py
+(`make loop-chaos`).
+"""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.observability import registry as _obs
+from lightgbm_tpu.reliability import (InjectedFault, counters, faults,
+                                      pin_bundle, pinned_bundle)
+from lightgbm_tpu.reliability.backoff import BackoffPolicy
+from lightgbm_tpu.reliability.checkpoint import (latest_checkpoint,
+                                                 save_checkpoint)
+from lightgbm_tpu.streaming import ArraySource, CSVSource, WindowSource
+from lightgbm_tpu.testing.chaos_loop import (collect_generation_models,
+                                             dyadic_model_transform,
+                                             loop_params, make_loop,
+                                             write_stream_csv)
+
+pytestmark = pytest.mark.loop
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _array_source(chunks=5, chunk_rows=8, f=3, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(chunks * chunk_rows, f)
+    y = rng.randn(chunks * chunk_rows).astype(np.float32)
+    return ArraySource(X, chunk_rows=chunk_rows, label=y), X, y
+
+
+# ----------------------------------------------------------------------
+# WindowSource
+def test_window_source_slices_array_zero_copy():
+    src, X, y = _array_source()
+    w = WindowSource(src, start_chunk=1, window_chunks=2)
+    assert w.num_rows == 16
+    assert w.array.base is not None          # a view, not a copy
+    np.testing.assert_array_equal(w.array, X[8:24])
+    got = list(w.chunks())
+    assert len(got) == 2
+    np.testing.assert_array_equal(np.vstack([c for c, _ in got]), X[8:24])
+    np.testing.assert_array_equal(np.concatenate([l for _, l in got]),
+                                  y[8:24])
+
+
+def test_window_source_partial_window_at_stream_end():
+    """A base that ends mid-window yields a clean partial pass — fewer
+    chunks, correct rows, never a torn one."""
+    src, X, _ = _array_source(chunks=5)
+    w = WindowSource(src, start_chunk=4, window_chunks=3)
+    assert w.num_rows == 8                   # only one chunk left
+    got = list(w.chunks())
+    assert len(got) == 1
+    np.testing.assert_array_equal(got[0][0], X[32:40])
+
+
+def test_window_source_past_end_is_empty():
+    src, _, _ = _array_source(chunks=5)
+    w = WindowSource(src, start_chunk=5, window_chunks=2)
+    assert w.num_rows == 0
+    assert list(w.chunks()) == []
+
+
+def test_window_source_restartable_within_window():
+    """chunks(start_chunk=k) re-opens the base at window offset k —
+    what mid-stream checkpoint resume replays from."""
+    src, X, _ = _array_source(chunks=6)
+    w = WindowSource(src, start_chunk=2, window_chunks=3)
+    resumed = list(w.chunks(start_chunk=1))
+    assert len(resumed) == 2
+    np.testing.assert_array_equal(resumed[0][0], X[24:32])
+    np.testing.assert_array_equal(resumed[1][0], X[32:40])
+    assert list(w.chunks(start_chunk=3)) == []
+
+
+def test_window_source_over_unsized_csv(tmp_path):
+    """Text sources don't know their size up front: the window's
+    num_rows starts None and a full pass fills it in; a window past
+    the end of the file yields nothing."""
+    path = str(tmp_path / "s.csv")
+    write_stream_csv(path, chunks=3, chunk_rows=10, f=4)
+    base = CSVSource(path, chunk_rows=10, label_col=0)
+    w = WindowSource(base, start_chunk=2, window_chunks=2)
+    assert w.num_rows is None
+    got = list(w.chunks())
+    assert len(got) == 1 and got[0][0].shape == (10, 4)
+    assert w.num_rows == 10
+    past = WindowSource(CSVSource(path, chunk_rows=10, label_col=0),
+                        start_chunk=3, window_chunks=1)
+    assert list(past.chunks()) == []
+    assert "window[2:4]" in w.describe()
+
+
+def test_window_source_validates_bounds():
+    src, _, _ = _array_source()
+    with pytest.raises(ValueError):
+        WindowSource(src, start_chunk=-1)
+    with pytest.raises(ValueError):
+        WindowSource(src, window_chunks=0)
+
+
+# ----------------------------------------------------------------------
+# BackoffPolicy
+def test_backoff_policy_capped_exponential():
+    p = BackoffPolicy(base_ms=50.0, max_ms=400.0, sleep=lambda s: None)
+    assert [p.delay_ms(a) for a in range(5)] == [50, 100, 200, 400, 400]
+    slept = []
+    p2 = BackoffPolicy(base_ms=10.0, max_ms=100.0, sleep=slept.append)
+    assert p2.wait(2) == 40.0
+    assert slept == [0.04]
+    assert BackoffPolicy(base_ms=0.0).delay_ms(7) == 0.0
+
+
+# ----------------------------------------------------------------------
+# pin-by-generation checkpoint retention
+def test_prune_never_deletes_pinned_live_generation(tmp_path):
+    d = str(tmp_path / "ck")
+    paths = {}
+    for it in range(1, 4):
+        paths[it] = save_checkpoint(d, it, f"model-{it}", {}, {},
+                                    keep_last=2)
+    # bundle 1 aged out of keep_last=2 normally
+    assert not os.path.isdir(paths[1])
+    pin_bundle(d, paths[2])
+    assert pinned_bundle(d) == 2
+    for it in range(4, 7):
+        save_checkpoint(d, it, f"model-{it}", {}, {}, keep_last=2)
+    # 2 is far past the quota but pinned: still there, readable
+    assert os.path.isdir(paths[2])
+    with open(os.path.join(paths[2], "model.txt")) as fh:
+        assert fh.read() == "model-2"
+    # unpin -> the next save's prune removes it
+    pin_bundle(d, None)
+    assert pinned_bundle(d) is None
+    save_checkpoint(d, 7, "model-7", {}, {}, keep_last=2)
+    assert not os.path.isdir(paths[2])
+
+
+def test_pinned_bundle_enoent_discipline(tmp_path):
+    d = str(tmp_path / "ck")
+    assert pinned_bundle(d) is None          # dir doesn't even exist
+    os.makedirs(d)
+    assert pinned_bundle(d) is None          # no pin file
+    with open(os.path.join(d, "PINNED"), "w") as fh:
+        fh.write("not-a-bundle-name\n")
+    assert pinned_bundle(d) is None          # garbled pin reads unpinned
+    pin_bundle(d, "ckpt_0000005")
+    assert pinned_bundle(d) == 5
+    pin_bundle(d, None)
+    pin_bundle(d, None)                      # double-unpin: ENOENT ok
+
+
+# ----------------------------------------------------------------------
+# freshness metric family
+def test_freshness_family_snapshot_and_prometheus():
+    _obs.reset()
+    _obs.record_freshness_publish(3, 1.25, slo_s=10.0)
+    f = _obs.freshness_snapshot()
+    assert f["generation"] == 3 and f["publishes"] == 1
+    assert f["data_to_serve_s"] == 1.25 and f["slo_alarm"] == 0
+    _obs.record_freshness_publish(4, 20.0, slo_s=10.0)
+    f = _obs.freshness_snapshot()
+    assert f["slo_alarm"] == 1 and f["slo_breaches"] == 1
+    assert f["max_data_to_serve_s"] == 20.0
+    _obs.record_freshness_publish(5, 0.5, slo_s=10.0)
+    assert _obs.freshness_snapshot()["slo_alarm"] == 0   # alarm clears
+    _obs.record_freshness_torn_publish(6)
+    _obs.record_freshness_quarantine(2)
+    f = _obs.freshness_snapshot()
+    assert f["torn_publishes"] == 1 and f["quarantined_windows"] == 1
+    txt = _obs.prometheus_text()
+    assert "lightgbm_tpu_freshness_generation 5" in txt
+    assert "lightgbm_tpu_freshness_quarantined_windows 1" in txt
+    assert "freshness" in _obs.snapshot()
+    _obs.reset()
+    assert _obs.freshness_snapshot()["publishes"] == 0
+
+
+# ----------------------------------------------------------------------
+# loop state machine
+@pytest.fixture
+def loop_env(tmp_path):
+    data = str(tmp_path / "stream.csv")
+    X = write_stream_csv(data, chunks=6, chunk_rows=32, f=5)
+    return data, str(tmp_path / "loop"), X
+
+
+def test_loop_refresh_and_exhaustion(loop_env):
+    """Happy path: windows refresh the live model (trees accumulate),
+    the stream's end stops the loop cleanly, and a rerun over the
+    exhausted stream publishes nothing but restores the live model."""
+    data, loop_dir, _X = loop_env
+    trainer, server, _cfg = make_loop(data, loop_params(loop_dir),
+                                      chunk_rows=32)
+    with server:
+        assert trainer.run() == 3            # 6 chunks / window of 2
+        assert trainer.generation == 3 and trainer.next_chunk == 6
+    first_model = trainer._live_model_str
+    assert first_model.count("Tree=") == 9   # 3 gens x loop_rounds=3
+    # restart over the exhausted stream: marker-driven recovery, no
+    # new generations, live model intact
+    t2, s2, _ = make_loop(data, loop_params(loop_dir), chunk_rows=32)
+    with s2:
+        assert t2.run() == 0
+        assert t2.generation == 3
+        assert t2._live_model_str == first_model
+        assert "live" in s2.registry
+
+
+def test_loop_source_ending_mid_window_publishes_partial(loop_env):
+    """5-chunk stream with 2-chunk windows: the last window has one
+    chunk — a clean partial refresh, then clean exhaustion."""
+    data, loop_dir, _X = loop_env
+    short = str(os.path.dirname(data) + "/short.csv")
+    write_stream_csv(short, chunks=5, chunk_rows=32, f=5)
+    trainer, server, _cfg = make_loop(short, loop_params(loop_dir),
+                                      chunk_rows=32)
+    with server:
+        assert trainer.run() == 3
+    assert trainer.next_chunk == 6           # cursor advances by window
+
+
+def test_recovery_discards_torn_generation_bundle(loop_env):
+    """A COMPLETE gens bundle newer than the marker is a torn publish:
+    recovery removes it and counts it in the freshness family."""
+    data, loop_dir, _X = loop_env
+    _obs.reset()
+    trainer, server, _cfg = make_loop(data, loop_params(loop_dir),
+                                      chunk_rows=32)
+    with server:
+        trainer.run(max_windows=1)
+        gens = os.path.join(loop_dir, "gens")
+        torn = save_checkpoint(gens, 7, "half-built", {}, {})
+        trainer._recover()
+        assert not os.path.isdir(torn)
+        assert _obs.freshness_snapshot()["torn_publishes"] == 1
+        assert collect_generation_models(loop_dir) \
+            and 7 not in collect_generation_models(loop_dir)
+        # the committed generation stays pinned and serving
+        assert pinned_bundle(gens) == 1
+        assert trainer.generation == 1
+
+
+@pytest.mark.parametrize("site", ["serving_hot_swap",
+                                  "serving_hot_swap_commit",
+                                  "loop_publish"])
+def test_mid_publish_kill_survivor_serves_a_real_generation(loop_env,
+                                                           site):
+    """Kill inside the publish sequence; the survivor must answer from
+    a real generation — the OLD one when the kill landed before the
+    atomic registry swap, the NEW one after it — and the retried cycle
+    must converge on the same bytes either way."""
+    from lightgbm_tpu.basic import Booster
+    data, loop_dir, X = loop_env
+    trainer, server, _cfg = make_loop(data, loop_params(loop_dir),
+                                      chunk_rows=32)
+    with server:
+        trainer.run(max_windows=1)
+        gen1 = trainer._live_model_str
+        ref1 = Booster(model_str=gen1).predict(X[:24], raw_score=True)
+        faults.schedule(site, fail=1)
+        with pytest.raises(InjectedFault):
+            trainer._recover()
+            trainer._run_cycle_once()
+        # survivor still answers, bit-identical to gen 1 or gen 2
+        got = np.asarray(server.predict("live", X[:24], raw_score=True))
+        if site == "serving_hot_swap":
+            # kill BEFORE the atomic swap: old generation serving
+            np.testing.assert_array_equal(got, ref1)
+        marker = json.load(open(os.path.join(loop_dir, "GENERATION")))
+        assert marker["generation"] == 1     # commit never advanced
+        # recovery + redo: generation 2 lands, identical either way
+        trainer._recover()
+        trainer._run_cycle_once()
+        gen2 = trainer._live_model_str
+        ref2 = Booster(model_str=gen2).predict(X[:24], raw_score=True)
+        assert np.array_equal(got, ref1) or np.array_equal(got, ref2)
+        now = np.asarray(server.predict("live", X[:24], raw_score=True))
+        np.testing.assert_array_equal(now, ref2)
+        marker = json.load(open(os.path.join(loop_dir, "GENERATION")))
+        assert marker["generation"] == 2
+    assert faults.trips(site) >= 1
+
+
+def test_poison_window_is_quarantined_and_loop_continues(loop_env):
+    """A window whose every rebuild attempt dies is skipped, logged,
+    counted — same generation, cursor advanced — and later windows
+    still publish."""
+    data, loop_dir, _X = loop_env
+    _obs.reset()
+    q0 = counters.get("loop_quarantined_windows")
+    trainer, server, _cfg = make_loop(data, loop_params(loop_dir),
+                                      chunk_rows=32)
+    with server:
+        trainer.run(max_windows=1)
+        # poison the second window: every construct dies, 3 attempts
+        faults.schedule("streaming_ingest", fail=3)
+        assert trainer.run() == 1            # window 3 still publishes
+    assert trainer.quarantined == [2]
+    assert counters.get("loop_quarantined_windows") == q0 + 1
+    assert _obs.freshness_snapshot()["quarantined_windows"] == 1
+    marker = json.load(open(os.path.join(loop_dir, "GENERATION")))
+    assert marker["quarantined"] == [2]
+    assert marker["generation"] == 2 and marker["next_chunk"] == 6
+
+
+def test_dyadic_transform_is_idempotent():
+    line = "leaf_value=0.123456789 -1.987654321 7.3\n"
+    once = dyadic_model_transform(line)
+    assert dyadic_model_transform(once) == once
+    vals = [float(v) for v in once.split("=")[1].split()]
+    assert all(abs(v * 1024 - round(v * 1024)) == 0 for v in vals)
+
+
+# ----------------------------------------------------------------------
+# streamed init_model seeding (engine-level satellite)
+def test_init_model_continuation_over_streamed_dataset():
+    """Continued boosting with a ChunkSource dataset seeds init scores
+    chunk by chunk and matches the in-memory continuation exactly."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.basic import Dataset
+    from lightgbm_tpu.engine import train
+    rng = np.random.RandomState(4)
+    X1, y1 = rng.randn(120, 5), rng.randn(120).astype(np.float32)
+    X2, y2 = rng.randn(96, 5), rng.randn(96).astype(np.float32)
+    params = {"objective": "regression", "num_leaves": 7,
+              "min_data_in_leaf": 5, "verbosity": -1,
+              "deterministic": True, "seed": 3}
+    base = train(dict(params), Dataset(X1, label=y1), num_boost_round=3)
+    streamed = Dataset(ArraySource(X2, chunk_rows=32, label=y2),
+                       params=dict(params), free_raw_data=False)
+    cont_s = train(dict(params), streamed, num_boost_round=2,
+                   init_model=base)
+    cont_m = train(dict(params),
+                   Dataset(X2, label=y2, params=dict(params)),
+                   num_boost_round=2, init_model=base)
+    assert cont_s.model_to_string() == cont_m.model_to_string()
+
+
+def test_init_model_over_exhausted_stream_raises():
+    from lightgbm_tpu.basic import Dataset
+    from lightgbm_tpu.engine import train
+    rng = np.random.RandomState(4)
+    X1, y1 = rng.randn(80, 4), rng.randn(80).astype(np.float32)
+    params = {"objective": "regression", "num_leaves": 7,
+              "min_data_in_leaf": 5, "verbosity": -1}
+    base = train(dict(params), Dataset(X1, label=y1), num_boost_round=2)
+    src, _, _ = _array_source(chunks=4, chunk_rows=8, f=4)
+    empty = WindowSource(src, start_chunk=4, window_chunks=1)
+    with pytest.raises(ValueError, match="exhausted stream"):
+        train(dict(params),
+              Dataset(empty, params=dict(params), free_raw_data=False),
+              num_boost_round=1, init_model=base)
+
+
+# ----------------------------------------------------------------------
+# config + CLI
+def test_config_registers_loop_task_and_params():
+    cfg = Config({"task": "loop", "loop_dir": "/tmp/x",
+                  "loop_state_dir": "/tmp/x",      # alias
+                  "loop_rounds": 5, "loop_window_chunks": 2,
+                  "loop_keep": 4, "loop_poison_retries": 2,
+                  "loop_backoff_ms": 10.0, "loop_backoff_max_ms": 80.0,
+                  "loop_freshness_slo_s": 30.0,
+                  "loop_model_name": "prod"})
+    assert cfg.task == "loop" and cfg.loop_rounds == 5
+    assert cfg.loop_freshness_slo_s == 30.0
+    assert cfg.loop_model_name == "prod"
+    with pytest.raises(Exception):
+        Config({"loop_rounds": 0})
+    with pytest.raises(Exception):
+        Config({"loop_poison_retries": 0})
+
+
+def test_cli_task_loop_end_to_end_and_restart(tmp_path):
+    """task=loop over a CSV stream: generations publish, the model and
+    serve metrics land on disk, and a rerun of the same conf resumes
+    from the GENERATION marker without retraining anything."""
+    from lightgbm_tpu.cli import Application
+    data = str(tmp_path / "stream.csv")
+    write_stream_csv(data, chunks=4, chunk_rows=32, f=5)
+    loop_dir = str(tmp_path / "loop")
+    out_model = str(tmp_path / "live.txt")
+    argv = [f"data={data}", "task=loop", f"loop_dir={loop_dir}",
+            "loop_rounds=2", "loop_window_chunks=2",
+            "stream_chunk_rows=32", f"output_model={out_model}",
+            "objective=regression", "num_leaves=7",
+            "min_data_in_leaf=5", "verbosity=-1",
+            "deterministic=true", "seed=3", "boost_from_average=false"]
+    _obs.reset()                 # the freshness family is process-global
+    Application(argv).run()
+    assert os.path.isfile(out_model)
+    with open(out_model) as fh:
+        first = fh.read()
+    assert first.count("Tree=") == 4         # 2 windows x 2 rounds
+    metrics = json.load(open(out_model + ".metrics.json"))
+    assert metrics["freshness"]["generation"] == 2
+    assert metrics["freshness"]["publishes"] == 2
+    marker = json.load(open(os.path.join(loop_dir, "GENERATION")))
+    assert marker["generation"] == 2 and marker["next_chunk"] == 4
+    saves0 = counters.get("checkpoint_saves")
+    _obs.reset()
+    Application(argv).run()                  # restart: stream exhausted
+    assert counters.get("checkpoint_saves") == saves0   # nothing redone
+    with open(out_model) as fh:
+        assert fh.read() == first
+    # the zero-publish restart still reports the generation it serves
+    metrics = json.load(open(out_model + ".metrics.json"))
+    assert metrics["freshness"]["generation"] == 2
+    assert metrics["freshness"]["publishes"] == 0

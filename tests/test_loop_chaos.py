@@ -1,0 +1,150 @@
+"""Continuous-loop chaos matrix (docs/Continuous.md, "Chaos protocol").
+
+The ISSUE-14 acceptance run, driven by `testing.chaos_loop` and marked
+slow (`make loop-chaos`): one unkilled reference run records every
+published generation's bytes, then the loop is killed at each fault
+site on the cycle path — ingest, train, generation cut, both sides of
+the serving swap, and the torn-publish window — while closed-loop
+traffic hammers the served entry. Every scenario must show:
+
+- zero dropped serve requests (every request in the ledger resolved);
+- every answer bit-identical to the host predict of SOME published
+  generation (the dyadic publish transform makes device f32 sums equal
+  host f64 sums, so equality is exact, not a tolerance);
+- every published generation — and the final live model — byte-
+  identical to the unkilled reference run;
+- at least one fault fired and one cycle failure was counted, with a
+  flushed flight-recorder postmortem per failed cycle.
+
+Poison-window quarantine and the freshness SLO alarm are then
+demonstrated from the metric family alone (no internal state reads).
+"""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.observability import registry as _obs
+from lightgbm_tpu.reliability import faults
+from lightgbm_tpu.testing.chaos_loop import (run_loop_scenario,
+                                             verify_survivor_answers,
+                                             write_stream_csv)
+
+pytestmark = [pytest.mark.loop, pytest.mark.slow]
+
+WINDOWS = 3
+N_REQUESTS = 120
+
+#: (site, schedule) — schedules are tuned to the in-process recovery
+#: ladders in front of each site so the fault actually kills the
+#: cycle: `histogram_build` sits inside retry_call(attempts=3), so 3
+#: consecutive failures are needed (skip=2 lands them mid-train, after
+#: two per-iteration checkpoints); `checkpoint_io` skips the 3
+#: callback saves (loop_rounds=3, swallowed by the callback) so the
+#: failure lands on the generation cut itself.
+KILL_MATRIX = [
+    ("streaming_ingest", {"skip": 1, "fail": 1}),
+    ("histogram_build", {"skip": 2, "fail": 3}),
+    ("checkpoint_io", {"skip": 3, "fail": 1}),
+    ("serving_hot_swap", {"fail": 1}),
+    ("serving_hot_swap_commit", {"fail": 1}),
+    ("loop_publish", {"fail": 1}),
+]
+
+
+@pytest.fixture(scope="module")
+def chaos_env(tmp_path_factory):
+    """Shared stream + ONE unkilled reference run. The reference and
+    every kill scenario reuse the same loop_dir path (wiped between
+    runs): the dir name is embedded in the model's parameters dump, so
+    byte-identity requires path equality, not just tree equality."""
+    root = tmp_path_factory.mktemp("loop_chaos")
+    data = str(root / "stream.csv")
+    X = write_stream_csv(data, chunks=6, chunk_rows=48, f=6)
+    loop_dir = str(root / "loop")
+    faults.clear()
+    ref = run_loop_scenario(data, loop_dir, X, windows=WINDOWS)
+    assert ref.bootstrap_published + ref.published == WINDOWS
+    assert sorted(ref.gen_models) == [1, 2, 3]
+    shutil.rmtree(loop_dir)
+    return data, loop_dir, X, ref
+
+
+@pytest.fixture(autouse=True)
+def _clean(chaos_env):
+    faults.clear()
+    _obs.reset()
+    shutil.rmtree(chaos_env[1], ignore_errors=True)
+    yield
+    faults.clear()
+
+
+@pytest.mark.parametrize("site,sched", KILL_MATRIX,
+                         ids=[s for s, _ in KILL_MATRIX])
+def test_kill_at_site_survives_under_live_traffic(chaos_env, site,
+                                                  sched):
+    data, loop_dir, X, ref = chaos_env
+    out = run_loop_scenario(data, loop_dir, X, windows=WINDOWS,
+                            site=site, n_requests=N_REQUESTS, **sched)
+    # the kill actually happened and was survived
+    assert out.trips >= 1, f"{site}: fault never fired"
+    assert out.cycle_failures >= 1, f"{site}: no cycle died"
+    assert out.bootstrap_published + out.published == WINDOWS
+    # zero dropped serve requests; nothing shed, nothing hung
+    assert out.load.dropped == 0
+    assert set(out.load.by_outcome()) == {"ok"}, out.load.by_outcome()
+    # every answer bit-identical to a real published generation
+    assert verify_survivor_answers(out.load, out.gen_models, X) \
+        == N_REQUESTS
+    # every generation — and the final live model — byte-identical to
+    # the unkilled reference
+    assert sorted(out.gen_models) == sorted(ref.gen_models)
+    for gen, model in ref.gen_models.items():
+        assert out.gen_models[gen] == model, \
+            f"{site}: generation {gen} diverged from unkilled run"
+    assert out.final_model == ref.final_model
+    # a flushed postmortem per failed cycle
+    assert len(out.postmortems) >= out.cycle_failures
+    assert out.quarantined == []
+
+
+def test_poison_window_quarantine_visible_from_metrics_alone(chaos_env):
+    """Window 2's every rebuild attempt dies (fail budget == the full
+    poison retry budget): it must be quarantined and the loop must
+    keep publishing — all observed via lightgbm_tpu_freshness."""
+    data, loop_dir, X, ref = chaos_env
+    out = run_loop_scenario(data, loop_dir, X, windows=WINDOWS,
+                            site="streaming_ingest", fail=3)
+    assert out.cycle_failures == 3
+    assert out.bootstrap_published + out.published == 2   # window 2 lost
+    # the metric family alone tells the story: publishes kept flowing,
+    # one window quarantined, generation advanced past the poison
+    txt = _obs.prometheus_text()
+    assert "lightgbm_tpu_freshness_quarantined_windows 1" in txt
+    assert "lightgbm_tpu_freshness_generation 2" in txt
+    assert "lightgbm_tpu_freshness_publishes 2" in txt
+    f = out.freshness
+    assert f["quarantined_windows"] == 1 and f["generation"] == 2
+    # published generations still match the reference prefix: gen 1
+    # bytes are identical; gen 2 trained on window 3's rows instead
+    assert out.gen_models[1] == ref.gen_models[1]
+    assert out.gen_models[2] != ref.gen_models[2]
+    assert len(out.postmortems) >= 3
+
+
+def test_freshness_slo_alarm_fires_from_metrics_alone(chaos_env):
+    """A sub-nanosecond staleness SLO must trip the alarm gauge on
+    every publish — no faults involved, pure watchdog."""
+    data, loop_dir, X, _ref = chaos_env
+    out = run_loop_scenario(
+        data, loop_dir, X, windows=2,
+        params_overrides={"loop_freshness_slo_s": 1e-9})
+    assert out.cycle_failures == 0
+    f = out.freshness
+    assert f["slo_alarm"] == 1 and f["slo_breaches"] == 2
+    assert f["staleness_slo_s"] == 1e-9
+    txt = _obs.prometheus_text()
+    assert "lightgbm_tpu_freshness_slo_alarm 1" in txt
+    assert "lightgbm_tpu_freshness_slo_breaches 2" in txt
