@@ -21,10 +21,20 @@ Output contract (mirrors bench.py):
   `bench.py --compare [--strict]` parses and the regression sentinel
   tracks exactly like BENCH_r*.
 
+A second, multi-model stage (PR 15) loads N small models + 1 large one
+— the tenant mix where per-model dispatch serializes — twice: unpacked
+(one DeviceForest + one queue each) and packed (`Server.load_pack`, one
+fused ForestPack dispatch + one continuous-batching queue). The same
+heavy-tailed open-loop schedule hits both; the record carries
+mm_packed_qps / mm_unpacked_qps / mm_packed_speedup and the matching
+p99s so the regression sentinel tracks the packed win per round.
+
 Env knobs: SERVE_BENCH_STAGES="qps:sec,qps:sec,..." (default ramp),
 SERVE_BENCH_REPLICAS (default 2), SERVE_BENCH_TREES /
 SERVE_BENCH_ROWS (model/pool size), SERVE_ROUND (record number),
-SERVE_BENCH_CHAOS=0 to disable fault injection.
+SERVE_BENCH_CHAOS=0 to disable fault injection,
+SERVE_MM_STAGES / SERVE_MM_SMALL (multi-model stage ramp / small-model
+count), SERVE_MM=0 to skip the multi-model stage.
 """
 
 import glob
@@ -134,11 +144,98 @@ def run_bench():
     return record
 
 
+def run_multimodel_bench():
+    """Packed vs unpacked serving of N small + 1 large model under one
+    heavy-tailed open-loop schedule. Returns the mm_* record fields."""
+    from lightgbm_tpu.serving import Server
+    from lightgbm_tpu.testing.chaos_serve import (dyadic_booster,
+                                                  run_open_loop)
+
+    n_small = int(os.environ.get("SERVE_MM_SMALL", 4))
+    replicas = int(os.environ.get("SERVE_BENCH_REPLICAS", 2))
+    stages = _parse_stages(os.environ.get(
+        "SERVE_MM_STAGES", "300:2,600:2,900:2"))
+    models = []
+    for i in range(n_small):
+        bst, _ = dyadic_booster(n=2048, f=16, trees=12, num_leaves=15,
+                                seed=20 + i)
+        models.append((f"small{i}", bst))
+    big, X = dyadic_booster(n=8192, f=16, trees=48, num_leaves=31,
+                            seed=7)
+    models.append(("large", big))
+    names = [nm for nm, _ in models]
+
+    def _run(packed):
+        # max_bucket 256: requests are tiny (the launch-bound tenant
+        # mix), so coalesced blocks never need the top of the ladder —
+        # and the warm loop below can afford to cover EVERY bucket,
+        # keeping compile time out of the measured window
+        with Server(min_bucket=16, max_bucket=256, max_wait_ms=0.5,
+                    max_queue=4096, n_replicas=replicas,
+                    retry_attempts=2, slo_ms=0.0,
+                    scheduler="slo") as srv:
+            if packed:
+                srv.load_pack("bench_pack", models)
+            else:
+                for nm, bst in models:
+                    srv.load_model(nm, booster=bst)
+            for s in (16, 32, 64, 128, 256):
+                for nm in names:
+                    srv.predict(nm, X[:s], raw_score=True)
+            per_stage = []
+            for si, (qps, dur) in enumerate(stages):
+                res = run_open_loop(srv, names[0], X, stages=[(qps, dur)],
+                                    max_rows=8, raw_score=True,
+                                    timeout_s=60.0, seed=300 + si,
+                                    names=names)
+                pct = res.latency_percentiles()
+                per_stage.append({
+                    "target_qps": qps,
+                    "achieved_qps": round(res.qps(), 3),
+                    "issued": res.issued, "dropped": res.dropped,
+                    **pct, **res.by_outcome()})
+                print(f"# serve mm detail: {'packed' if packed else 'unpacked'}"
+                      f" stage {si} target {qps:g} -> "
+                      f"{res.qps():.1f} qps, p99 {pct['p99_ms']} ms",
+                      file=sys.stderr)
+            extra = {}
+            if packed:
+                psnap = srv.metrics_snapshot()["packs"].get(
+                    "bench_pack", {})
+                extra = {k: psnap.get(k) for k in
+                         ("fused_dispatches", "occupancy",
+                          "avg_slots_active", "interleaves",
+                          "compile_count")}
+        within = [s for s in per_stage
+                  if s["p99_ms"] < P99_SLO_MS and s["dropped"] == 0]
+        best = max(within, key=lambda s: s["achieved_qps"]) if within \
+            else min(per_stage, key=lambda s: s["p99_ms"])
+        return {"best": best, "slo_held": bool(within),
+                "stages": per_stage, **extra}
+
+    unpacked = _run(packed=False)
+    packed = _run(packed=True)
+    speedup = packed["best"]["achieved_qps"] / \
+        max(unpacked["best"]["achieved_qps"], 1e-9)
+    return {
+        "mm_packed_qps": packed["best"]["achieved_qps"],
+        "mm_packed_p99_ms": packed["best"]["p99_ms"],
+        "mm_unpacked_qps": unpacked["best"]["achieved_qps"],
+        "mm_unpacked_p99_ms": unpacked["best"]["p99_ms"],
+        "mm_packed_speedup": round(speedup, 3),
+        "multimodel": {
+            "n_small": n_small, "large_trees": 48,
+            "packed": packed, "unpacked": unpacked},
+    }
+
+
 def main():
     rnd = _next_round()
     cmd = "python bench_serve.py"
     try:
         record = run_bench()
+        if os.environ.get("SERVE_MM", "1") != "0":
+            record.update(run_multimodel_bench())
         rc = 0
         line = json.dumps(record)
         print(line)

@@ -32,6 +32,7 @@ DISPATCH_MANIFEST = (
     ("gbdt.py", "_grow", "collective_psum"),
     ("engine.py", "predict_raw", "serving_device_predict"),
     ("replicas.py", "dispatch", "serving_replica_predict"),
+    ("multimodel.py", "dispatch_pack", "serving_pack_predict"),
     ("server.py", "hot_swap", "serving_hot_swap"),
     ("server.py", "hot_swap", "serving_hot_swap_commit"),
     ("checkpoint.py", "save_checkpoint", "checkpoint_io"),
@@ -55,6 +56,7 @@ SITE_WRAPPERS = {
 _DIR_HINTS = {
     ("engine.py", "predict_raw"): "serving",
     ("replicas.py", "dispatch"): "serving",
+    ("multimodel.py", "dispatch_pack"): "serving",
     ("server.py", "hot_swap"): "serving",
     ("checkpoint.py", "save_checkpoint"): "reliability",
     ("gbdt.py", "train_many_dispatch"): "boosting",

@@ -224,6 +224,18 @@ _PARAMS: List[ParamSpec] = [
        desc="how long an open breaker refuses dispatches before "
             "granting one half-open probe; a clean probe re-closes the "
             "breaker (self-healing)"),
+    _p("serve_scheduler", str, "slo", ("batch_scheduler",),
+       lambda v: v in ("fifo", "slo"),
+       desc="micro-batch scheduling policy: 'slo' (default, continuous "
+            "batching) orders the queue by remaining deadline budget "
+            "with skip-and-fill packing so small requests interleave "
+            "around large ones (a starvation guard bounds reordering); "
+            "'fifo' keeps strict arrival order"),
+    _p("serve_pack_size", int, 8, ("pack_size",), lambda v: v >= 1,
+       desc="max members per fused multi-model ForestPack loaded via "
+            "Server.load_pack; more members than this split into "
+            "multiple packs. Each pack answers its whole member set "
+            "with one device dispatch per coalescing round"),
     # ---- Observability (lightgbm_tpu/observability/,
     #      docs/Observability.md) ----
     _p("observe", bool, False, ("observability",),

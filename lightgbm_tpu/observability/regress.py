@@ -145,7 +145,12 @@ def _bench_points(records) -> Dict[str, List[Tuple[int, float]]]:
         value = parsed.get("value")
         if isinstance(value, (int, float)) and value > 0:
             series.setdefault(metric, []).append((rnd, float(value)))
-            for key in ("vs_baseline", "vs_single_core"):
+            # ratio/aux side-channels tracked with the same drop
+            # detector: multichip ratios, and the serve bench's
+            # packed-vs-unpacked multi-model columns (PR 15)
+            for key in ("vs_baseline", "vs_single_core",
+                        "mm_packed_qps", "mm_unpacked_qps",
+                        "mm_packed_speedup"):
                 v = parsed.get(key)
                 if isinstance(v, (int, float)) and v > 0:
                     series.setdefault(f"{metric}:{key}", []) \

@@ -73,6 +73,7 @@ KNOWN_SITES = (
     "collective_psum",
     "serving_device_predict",
     "serving_replica_predict",
+    "serving_pack_predict",
     "serving_hot_swap",
     "serving_hot_swap_commit",
     "checkpoint_io",

@@ -151,6 +151,20 @@ class DeviceForest:
             raw = self._model._convert_output(raw)
         return raw[:, 0] if self.num_outputs == 1 else raw
 
+    def place_on(self, device) -> "DeviceForest":
+        """The same logical forest with its device arrays pinned to
+        `device`; host-side binners and the fallback model are shared
+        (arrays are immutable, so replicas share nothing mutable).
+        `ForestPack` implements the same method — replica placement is
+        polymorphic over single models and packs."""
+        import jax
+        return dataclasses.replace(
+            self,
+            stacked=jax.device_put(self.stacked, device),
+            tree_class=jax.device_put(self.tree_class, device),
+            num_bins=jax.device_put(self.num_bins, device),
+            missing_is_nan=jax.device_put(self.missing_is_nan, device))
+
     def nbytes_device(self) -> int:
         import jax
         return sum(int(np.prod(a.shape)) * a.dtype.itemsize

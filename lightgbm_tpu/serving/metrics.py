@@ -17,7 +17,7 @@ import numpy as np
 
 from ..utils.timer import global_timer
 
-__all__ = ["ModelMetrics"]
+__all__ = ["ModelMetrics", "PackMetrics"]
 
 _PERCENTILES = (50.0, 95.0, 99.0)
 
@@ -135,6 +135,76 @@ class ModelMetrics:
                 out[key] = round(float(np.percentile(lats, p)), 3) \
                     if n else None
         return out
+
+
+class PackMetrics:
+    """Counters for one ForestPack's fused dispatch path (the
+    ``lightgbm_tpu_multimodel`` Prometheus family, docs/
+    Observability.md). Occupancy is packed rows over slot-grouped
+    capacity — low occupancy means the resident members rarely have
+    concurrent traffic and the pack is mostly padding."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.fused_dispatches = 0     # kernel launches (rounds)
+        self.packed_rows = 0          # real rows scored through the pack
+        self.capacity_rows = 0        # slots * row_block summed
+        self.slots_active_total = 0   # slots with rows, summed per round
+        self.compile_count = 0        # pack bucket-cache misses
+        self.rebuilds = 0             # pack republished (evict/hot-swap)
+        self.rebuild_drains = 0       # futures host-drained by a rebuild
+        self.device_retries = 0
+        self.guard_trips = 0
+        self.failovers = 0
+
+    def record_dispatch(self, rows: int, capacity: int, slots: int,
+                        compiled: bool) -> None:
+        with self._lock:
+            self.fused_dispatches += 1
+            self.packed_rows += int(rows)
+            self.capacity_rows += int(capacity)
+            self.slots_active_total += int(slots)
+            if compiled:
+                self.compile_count += 1
+
+    def record_rebuild(self, drained: int = 0) -> None:
+        with self._lock:
+            self.rebuilds += 1
+            self.rebuild_drains += int(drained)
+
+    # the replica fleet's retry/failover bookkeeping (replicas.dispatch)
+    # records against the pack when the whole pack fails over
+    def record_retry(self) -> None:
+        with self._lock:
+            self.device_retries += 1
+
+    def record_guard_trip(self) -> None:
+        with self._lock:
+            self.guard_trips += 1
+
+    def record_failover(self) -> None:
+        with self._lock:
+            self.failovers += 1
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            occupancy = (self.packed_rows / self.capacity_rows) \
+                if self.capacity_rows else 0.0
+            avg_slots = (self.slots_active_total / self.fused_dispatches) \
+                if self.fused_dispatches else 0.0
+            return {
+                "fused_dispatches": self.fused_dispatches,
+                "packed_rows": self.packed_rows,
+                "capacity_rows": self.capacity_rows,
+                "occupancy": round(occupancy, 4),
+                "avg_slots_active": round(avg_slots, 3),
+                "compile_count": self.compile_count,
+                "rebuilds": self.rebuilds,
+                "rebuild_drains": self.rebuild_drains,
+                "device_retries": self.device_retries,
+                "guard_trips": self.guard_trips,
+                "failovers": self.failovers,
+            }
 
 
 def timer_totals() -> Dict[str, float]:

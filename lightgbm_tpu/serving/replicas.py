@@ -26,7 +26,6 @@ open, the traffic fail over, and the probe re-close it.
 
 from __future__ import annotations
 
-import dataclasses
 import threading
 import time
 from typing import Dict, List, Optional
@@ -93,20 +92,12 @@ class Replica:
         return snap
 
 
-def _replicated_forest(forest: DeviceForest, device) -> DeviceForest:
-    """The same logical forest with its device arrays pinned to
-    `device`; host-side binners and the fallback model are shared."""
-    import jax
-    return dataclasses.replace(
-        forest,
-        stacked=jax.device_put(forest.stacked, device),
-        tree_class=jax.device_put(forest.tree_class, device),
-        num_bins=jax.device_put(forest.num_bins, device),
-        missing_is_nan=jax.device_put(forest.missing_is_nan, device))
-
-
 class ReplicaSet:
-    """Least-loaded, breaker-gated routing across replicas."""
+    """Least-loaded, breaker-gated routing across replicas.
+
+    `forest` may be a single DeviceForest or a multimodel.ForestPack —
+    anything carrying `supported` and `place_on(device)`; the fleet is
+    agnostic to what one dispatch scores."""
 
     def __init__(self, replicas: List[Replica], name: str = "model"):
         self.name = name
@@ -142,7 +133,7 @@ class ReplicaSet:
                 # bucket cache stays warm across replicas)
                 rep_forest = forest
             else:
-                rep_forest = _replicated_forest(forest, dev)
+                rep_forest = forest.place_on(dev)
             breaker = CircuitBreaker(threshold=breaker_threshold,
                                      cooldown_s=breaker_cooldown_ms / 1e3,
                                      clock=clock)
@@ -177,12 +168,19 @@ class ReplicaSet:
     # ------------------------------------------------------------------
     def dispatch(self, engine, bins: np.ndarray, *, metrics=None,
                  retry_attempts: int = 3, retry_backoff_ms: float = 50.0,
-                 retry_backoff_max_ms: float = 2000.0) -> np.ndarray:
+                 retry_backoff_max_ms: float = 2000.0,
+                 attempt_fn=None) -> np.ndarray:
         """Route one coalesced batch: least-loaded breaker-granted
         replica, capped-backoff retries on it, breaker bookkeeping,
         failover to the next replica on final failure. Raises
         `NoReplicaAvailable` when every replica refuses — the caller's
-        host-fallback rung takes over."""
+        host-fallback rung takes over.
+
+        `attempt_fn(replica) -> raw` overrides what one attempt runs
+        (the fused pack dispatch passes `multimodel.dispatch_pack`
+        here); the default scores `bins` through the bucketed engine.
+        Either way the attempt runs inside this retry/breaker/failover
+        bracket and its per-dispatch fault site."""
         from ..reliability import faults
 
         tried: set = set()
@@ -212,6 +210,8 @@ class ReplicaSet:
                     # registered fault site: the per-replica device
                     # dispatch boundary (chaos kills land here)
                     faults.inject("serving_replica_predict")
+                    if attempt_fn is not None:
+                        return attempt_fn(_rep)
                     return engine.predict_raw(_rep.forest, bins,
                                               metrics=metrics)
 

@@ -44,8 +44,8 @@ import numpy as np
 from ..reliability import counters, faults
 from ..utils.log import Log, LightGBMError
 from ..utils.timer import global_timer
-from .batcher import (BatcherClosed, DeadlineExceeded, MicroBatcher,
-                      OverloadError)
+from .batcher import (SCHEDULERS, BatcherClosed, DeadlineExceeded,
+                      MicroBatcher, OverloadError)
 from .engine import BucketedPredictor, max_compilations
 from .metrics import timer_totals
 from .registry import ModelEntry, ModelRegistry
@@ -70,10 +70,15 @@ class Server:
                  retry_backoff_max_ms: float = 2000.0,
                  slo_ms: float = 0.0, deadline_policy: str = "fallback",
                  n_replicas: int = 1, breaker_threshold: int = 3,
-                 breaker_cooldown_ms: float = 250.0):
+                 breaker_cooldown_ms: float = 250.0,
+                 scheduler: str = "slo", pack_size: int = 8):
         if deadline_policy not in DEADLINE_POLICIES:
             raise ValueError(
                 f"deadline_policy must be one of {DEADLINE_POLICIES}")
+        if scheduler not in SCHEDULERS:
+            raise ValueError(f"scheduler must be one of {SCHEDULERS}")
+        if pack_size < 1:
+            raise ValueError("pack_size must be >= 1")
         self.engine = BucketedPredictor(min_bucket=min_bucket,
                                         max_bucket=max_bucket)
         self.max_batch_size = int(max_batch_size)
@@ -87,10 +92,13 @@ class Server:
         self.n_replicas = int(n_replicas)
         self.breaker_threshold = int(breaker_threshold)
         self.breaker_cooldown_ms = float(breaker_cooldown_ms)
+        self.scheduler = scheduler
+        self.pack_size = int(pack_size)
         self.registry = ModelRegistry(
             max_models=max_models,
             replica_factory=self._build_replicas,
-            batcher_factory=self._build_batcher)
+            batcher_factory=self._build_batcher,
+            pack_batcher_factory=self._build_pack_batcher)
         self._lock = threading.Lock()
         self._closed = False
         self._metrics_server = None
@@ -111,7 +119,9 @@ class Server:
                    deadline_policy=config.serve_deadline_policy,
                    n_replicas=config.serve_replicas,
                    breaker_threshold=config.serve_breaker_threshold,
-                   breaker_cooldown_ms=config.serve_breaker_cooldown_ms)
+                   breaker_cooldown_ms=config.serve_breaker_cooldown_ms,
+                   scheduler=config.serve_scheduler,
+                   pack_size=config.serve_pack_size)
 
     # ------------------------------------------------------------------
     # registry factories: each entry owns its replica fleet + batcher
@@ -126,7 +136,17 @@ class Server:
             self._make_runner(entry),
             max_batch_size=self.max_batch_size,
             max_wait_ms=self.max_wait_ms,
-            max_queue=self.max_queue, name=entry.name)
+            max_queue=self.max_queue, name=entry.name,
+            scheduler=self.scheduler)
+
+    def _build_pack_batcher(self, pe):
+        from .multimodel import PackBatcher
+        return PackBatcher(
+            self._make_pack_runner(pe),
+            max_batch_size=self.max_batch_size,
+            max_wait_ms=self.max_wait_ms,
+            max_queue=self.max_queue, name=pe.name,
+            scheduler=self.scheduler)
 
     def _make_runner(self, entry: ModelEntry):
         # closes over the ENTRY, not the name: a hot-swap can never
@@ -137,6 +157,30 @@ class Server:
                     f"model '{entry.name}' has no device replicas")
             return entry.replicas.dispatch(
                 self.engine, bins, metrics=entry.metrics,
+                retry_attempts=self.retry_attempts,
+                retry_backoff_ms=self.retry_backoff_ms,
+                retry_backoff_max_ms=self.retry_backoff_max_ms)
+        return run
+
+    def _make_pack_runner(self, pe):
+        # closes over the PackEntry: a pack rebuild publishes a new
+        # entry with a new batcher+runner, so queued (slot, bins) can
+        # never score against a different pack layout
+        from .multimodel import dispatch_pack
+
+        def run(reqs) -> np.ndarray:
+            if pe.replicas is None or len(pe.replicas) == 0:
+                raise NoReplicaAvailable(
+                    f"pack '{pe.name}' has no device replicas")
+
+            def attempt(rep):
+                return dispatch_pack(self.engine, rep.forest, reqs,
+                                     metrics_by_slot=pe.slot_metrics,
+                                     pack_metrics=pe.metrics)
+
+            return pe.replicas.dispatch(
+                self.engine, None, metrics=pe.metrics,
+                attempt_fn=attempt,
                 retry_attempts=self.retry_attempts,
                 retry_backoff_ms=self.retry_backoff_ms,
                 retry_backoff_max_ms=self.retry_backoff_max_ms)
@@ -155,6 +199,30 @@ class Server:
                                        model_file=model_file,
                                        model_str=model_str)
         return entry
+
+    def load_pack(self, pack_name: str, members):
+        """Load several models as fused multi-model packs.
+
+        `members` is a sequence of ``(name, booster)`` pairs (or
+        ``(name, {"model_file": ...})`` dicts). Members are packed in
+        chunks of at most `pack_size`; chunk ``i > 0`` gets the pack
+        name ``f"{pack_name}/{i}"``. Each member still answers
+        `predict(name, ...)` under its own name — packing only changes
+        HOW the device dispatch happens (one fused launch for the
+        whole pack instead of one per model). Returns the member
+        entries in input order."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("server is closed")
+        members = list(members)
+        entries = []
+        with global_timer.timeit("serve_model_load"):
+            for i in range(0, len(members), self.pack_size):
+                chunk = members[i:i + self.pack_size]
+                nm = pack_name if i == 0 else \
+                    f"{pack_name}/{i // self.pack_size}"
+                entries.extend(self.registry.load_pack(nm, chunk))
+        return entries
 
     def hot_swap(self, name: str, booster=None,
                  model_file: Optional[str] = None,
@@ -265,12 +333,17 @@ class Server:
             return out
         with global_timer.timeit("serve_bin_rows"):
             bins = entry.forest.bin_rows(X)
-        batcher = entry.batcher
+        # pack members share the PACK's slot-aware queue; solo models
+        # keep their own
+        batcher = entry.batcher if entry.pack is None \
+            else entry.pack.batcher
         if batcher is None:
             self._host_resolve(entry, X, raw_score, t0, out)
             return out
         try:
-            raw_future = batcher.submit(bins, deadline=deadline)
+            raw_future = batcher.submit(
+                bins, deadline=deadline,
+                slot=entry.pack_slot if entry.pack is not None else None)
         except OverloadError:
             entry.metrics.record_shed()
             raise
@@ -326,6 +399,10 @@ class Server:
                 self._host_resolve(entry, X, raw_score, t0, out)
                 return
             try:
+                if entry.pack is not None:
+                    # the fused kernel scores into the pack's padded
+                    # output width; this member's columns come first
+                    raw = raw[:, :entry.forest.num_outputs]
                 res = entry.forest.convert_raw(raw, raw_score=raw_score)
             except Exception as exc:
                 out.set_exception(exc)
@@ -350,13 +427,18 @@ class Server:
         counters.inc("fallbacks")
         out.set_result(res)
 
-    # test/ops hook: the model's queue (pause/resume/queue_depth)
+    # test/ops hook: the model's queue (pause/resume/queue_depth);
+    # pack members answer with the pack's shared queue
     def batcher(self, name: str) -> MicroBatcher:
-        return self.registry.get(name).batcher
+        entry = self.registry.get(name)
+        return entry.batcher if entry.pack is None \
+            else entry.pack.batcher
 
     # test/ops hook: the model's replica fleet (breakers, failovers)
     def replicas(self, name: str) -> ReplicaSet:
-        return self.registry.get(name).replicas
+        entry = self.registry.get(name)
+        return entry.replicas if entry.pack is None \
+            else entry.pack.replicas
 
     # ------------------------------------------------------------------
     # metrics
@@ -373,6 +455,9 @@ class Server:
             snap["version"] = entry.version
             snap["degraded"] = entry.degraded
             snap["device_resident"] = entry.forest.supported
+            if entry.pack is not None:
+                snap["pack"] = entry.pack.name
+                snap["pack_slot"] = entry.pack_slot
             if entry.replicas is not None:
                 rsnap = entry.replicas.snapshot()
                 snap["replica_count"] = rsnap["replica_count"]
@@ -388,9 +473,32 @@ class Server:
                 snap["deadline_expired_count"] = \
                     batcher.deadline_expired_count
             models[nm] = snap
+        packs = {}
+        for pname, pe in self.registry.packs().items():
+            psnap = pe.metrics.snapshot()
+            psnap["version"] = pe.version
+            psnap["members"] = list(pe.member_names())
+            psnap["num_slots"] = pe.pack.num_slots
+            psnap["num_trees"] = pe.pack.num_trees
+            if pe.replicas is not None:
+                rsnap = pe.replicas.snapshot()
+                psnap["replica_count"] = rsnap["replica_count"]
+                psnap["breaker_open_replicas"] = \
+                    rsnap["breaker_open_replicas"]
+            if pe.batcher is not None:
+                psnap["inflight"] = pe.batcher.queue_depth()
+                psnap["coalesced_batches"] = pe.batcher.batch_count
+                psnap["interleaves"] = pe.batcher.interleave_count
+                psnap["deadline_shed_count"] = \
+                    pe.batcher.deadline_shed_count
+                psnap["deadline_expired_count"] = \
+                    pe.batcher.deadline_expired_count
+            packs[pname] = psnap
         return {
             "models": models,
+            "packs": packs,
             "engine": {
+                "pack_rebuilds": self.registry.pack_rebuilds,
                 "compile_count": self.engine.compile_count,
                 "bucket_cache_hits": self.engine.hit_count,
                 "device_batches": self.engine.device_batches,
@@ -432,6 +540,11 @@ class Server:
                      "failures": rep["failures"]},
                     "lightgbm_tpu_serving_replica",
                     {"model": nm, "replica": str(rep["replica"])}))
+        for pname, p in snap.get("packs", {}).items():
+            p = dict(p)
+            p.pop("members", None)
+            sections.append((p, "lightgbm_tpu_multimodel",
+                             {"pack": pname}))
         sections.append((snap["engine"], "lightgbm_tpu_serving_engine",
                          None))
         return render_prometheus(sections) + _obs.prometheus_text()

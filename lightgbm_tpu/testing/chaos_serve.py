@@ -99,6 +99,7 @@ class RequestRecord:
     latency_ms: float = 0.0
     value: Optional[np.ndarray] = None
     error: str = ""
+    model: str = ""                # multi-model load: which name served it
 
 
 @dataclass
@@ -150,7 +151,7 @@ def _issue(server, name: str, X: np.ndarray, rec: RequestRecord,
     from ..serving import DeadlineExceeded, OverloadError
     t0 = time.perf_counter()
     try:
-        rec.value = server.predict(name, X[rec.lo:rec.hi],
+        rec.value = server.predict(rec.model or name, X[rec.lo:rec.hi],
                                    raw_score=raw_score,
                                    timeout=timeout_s)
         rec.outcome = "ok"
@@ -215,12 +216,15 @@ def run_open_loop(server, name: str, X: np.ndarray, *,
                   stages: Sequence[Tuple[float, float]],
                   max_rows: int = 64, raw_score: bool = True,
                   timeout_s: float = 30.0, seed: int = 0,
-                  mid_run=None) -> LoadResult:
+                  mid_run=None,
+                  names: Optional[Sequence[str]] = None) -> LoadResult:
     """Open-loop load: requests arrive on a fixed schedule regardless
     of completion (the honest way to measure tail latency — a closed
     loop self-throttles when the server slows). `stages` is a QPS ramp
     of (qps, duration_s) pairs. `mid_run(stage_index)` fires at each
-    stage boundary past the first."""
+    stage boundary past the first. `names` spreads the load uniformly
+    over several served models (multi-model/pack benches); each
+    record's `model` field says which one answered it."""
     rng = np.random.RandomState(seed)
     records: List[RequestRecord] = []
     threads: List[threading.Thread] = []
@@ -233,10 +237,13 @@ def run_open_loop(server, name: str, X: np.ndarray, *,
         gaps = np.full(n, 1.0 / max(qps, 1e-9))
         sizes = heavy_tailed_sizes(rng, n, max_rows)
         starts = rng.randint(0, max(len(X) - max_rows, 1), size=n)
+        picks = rng.randint(0, len(names), size=n) \
+            if names else np.zeros(n, np.int64)
         stage_t0 = time.perf_counter()
         for k in range(n):
             rec = RequestRecord(idx, int(starts[k]),
-                                int(starts[k] + sizes[k]))
+                                int(starts[k] + sizes[k]),
+                                model=names[picks[k]] if names else "")
             idx += 1
             records.append(rec)
             th = threading.Thread(
@@ -257,16 +264,19 @@ def run_open_loop(server, name: str, X: np.ndarray, *,
 
 
 def verify_bit_identical(result: LoadResult, booster,
-                         X: np.ndarray) -> int:
+                         X: np.ndarray, boosters=None) -> int:
     """Every 'ok' answer must equal the host predict of the same rows,
     bit for bit (requires a `dyadic_booster` model and raw_score=True
-    load). Returns how many records were checked; raises AssertionError
-    with the first mismatch otherwise."""
+    load). Multi-model loads pass `boosters` ({name: booster}) so each
+    record checks against ITS model. Returns how many records were
+    checked; raises AssertionError with the first mismatch otherwise."""
     checked = 0
     for rec in result.ok_records():
-        ref = booster.predict(X[rec.lo:rec.hi], raw_score=True)
+        ref_bst = boosters[rec.model] if boosters and rec.model \
+            else booster
+        ref = ref_bst.predict(X[rec.lo:rec.hi], raw_score=True)
         assert np.array_equal(np.asarray(rec.value), ref), (
-            f"request {rec.idx} rows [{rec.lo},{rec.hi}) diverged from "
-            f"host predict")
+            f"request {rec.idx} rows [{rec.lo},{rec.hi}) "
+            f"model '{rec.model}' diverged from host predict")
         checked += 1
     return checked

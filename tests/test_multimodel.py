@@ -1,0 +1,425 @@
+"""Multi-model fused serving tests (docs/Serving.md "Multi-model
+packing" / "Continuous batching").
+
+The pack contract under test:
+
+- packed answers are BIT-identical to the member's solo device predict
+  (same f32 accumulation order) across heterogeneous objectives and
+  adversarial categorical/missing inputs, and bit-identical to host
+  predict on dyadic boosters;
+- a pack costs at most ``max_compilations(max_bucket)`` fused-kernel
+  compilations total, member count notwithstanding (the
+  `_packed_fn()._cache_size()` guard);
+- the ``slo`` scheduler skip-and-fills around requests that don't fit
+  the batch, ``fifo`` stays a strict prefix;
+- admission's rows-aware service model cannot death-spiral on a
+  poisoned estimate (empty queue always admits) and never counts
+  looser-deadline work against a tight incoming request;
+- evicting / hot-swapping one member rebuilds the pack for the
+  survivors and drains the old queue through host predict exactly
+  once per future — under live load, with `serving_pack_predict`
+  faults firing, zero requests drop and every answer stays bit-equal
+  to a published model.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.reliability import faults
+from lightgbm_tpu.serving import (DeadlineExceeded, MicroBatcher,
+                                  Server, max_compilations)
+from lightgbm_tpu.serving.batcher import _ServiceModel
+from lightgbm_tpu.serving.multimodel import _packed_fn
+from lightgbm_tpu.testing.chaos_serve import (LoadResult,
+                                              dyadic_booster,
+                                              run_open_loop,
+                                              verify_bit_identical)
+from tests.conftest import make_binary, make_multiclass, make_regression
+
+RTOL, ATOL = 1e-5, 1e-7
+
+
+def _train(objective="binary", n=400, f=8, seed=0, rounds=8):
+    if objective == "multiclass":
+        X, y = make_multiclass(n=n, f=f, k=3, seed=seed)
+        params = {"objective": "multiclass", "num_class": 3}
+    elif objective == "regression":
+        X, y = make_regression(n=n, f=f, seed=seed)
+        params = {"objective": "regression"}
+    else:
+        X, y = make_binary(n=n, f=f, seed=seed)
+        params = {"objective": "binary"}
+    params.update({"num_leaves": 15, "min_data_in_leaf": 5,
+                   "verbosity": -1})
+    bst = lgb.train(params, lgb.Dataset(X, label=y),
+                    num_boost_round=rounds)
+    return bst, X
+
+
+def _train_categorical(seed=7):
+    r = np.random.RandomState(seed)
+    X = r.randn(400, 5)
+    X[:, 2] = r.randint(0, 12, 400)
+    X[r.rand(400) < 0.15, 0] = np.nan
+    y = ((X[:, 2] % 3 == 0) + 0.1 * np.nan_to_num(X[:, 0])) \
+        .astype(np.float32)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "min_data_in_leaf": 5, "verbosity": -1},
+                    lgb.Dataset(X, label=y, categorical_feature=[2]),
+                    num_boost_round=6)
+    Xq = X[:60].copy()
+    Xq[0, 2] = 99          # unseen category -> right child
+    Xq[1, 2] = np.nan      # NaN category -> right child
+    Xq[2, 0] = np.nan      # missing numeric on a NaN-typed feature
+    return bst, Xq
+
+
+# ---------------------------------------------------------------------------
+# bit-identity across the objective matrix
+
+
+def test_pack_bit_identical_to_solo_device_and_close_to_host():
+    """One pack holding regression + binary + multiclass + a
+    categorical/NaN model answers every member bit-identically to that
+    member's SOLO device predict (identical f32 accumulation order),
+    and within f32-vs-f64 tolerance of host predict."""
+    reg, Xr = _train("regression", seed=0)
+    binm, Xb = _train("binary", seed=1)
+    mc, Xm = _train("multiclass", seed=2)
+    cat, Xq = _train_categorical()
+    members = [("reg", reg), ("bin", binm), ("mc", mc), ("cat", cat)]
+    queries = {"reg": Xr[:37], "bin": Xb[:64], "mc": Xm[:21],
+               "cat": Xq}
+
+    solo = {}
+    with Server(min_bucket=4, max_bucket=64) as srv:
+        for nm, bst in members:
+            srv.load_model(nm, booster=bst)
+            solo[nm] = srv.predict(nm, queries[nm], raw_score=True)
+
+    with Server(min_bucket=4, max_bucket=64, pack_size=8) as srv:
+        srv.load_pack("matrix", members)
+        for nm, bst in members:
+            got = srv.predict(nm, queries[nm], raw_score=True)
+            assert np.array_equal(got, solo[nm]), \
+                f"packed '{nm}' diverged from its solo device predict"
+            np.testing.assert_allclose(
+                got, bst.predict(queries[nm], raw_score=True),
+                rtol=RTOL, atol=ATOL)
+            # transformed output rides the member's own converter
+            np.testing.assert_allclose(
+                srv.predict(nm, queries[nm]), bst.predict(queries[nm]),
+                rtol=RTOL, atol=ATOL)
+
+
+def test_pack_dyadic_bit_identical_to_host():
+    """Dyadic members make f32 device sums == f64 host sums, so packed
+    serving must match host predict to the last bit."""
+    members = [(f"d{i}", dyadic_booster(trees=8 + 6 * i,
+                                        seed=30 + i)[0])
+               for i in range(3)]
+    _, X = dyadic_booster(seed=30)
+    with Server(min_bucket=4, max_bucket=64, pack_size=4) as srv:
+        srv.load_pack("dy", members)
+        for nm, bst in members:
+            for rows in (1, 5, 16, 33):
+                got = srv.predict(nm, X[:rows], raw_score=True)
+                assert np.array_equal(
+                    got, bst.predict(X[:rows], raw_score=True))
+
+
+# ---------------------------------------------------------------------------
+# compile accounting
+
+
+def test_pack_compile_count_bounded():
+    """Whatever the member count and traffic mix, one pack compiles
+    the fused kernel at most max_compilations(max_bucket) times — the
+    bucket ladder bound applies per PACK, not per member."""
+    members = [(f"m{i}", dyadic_booster(trees=6 + i, seed=40 + i)[0])
+               for i in range(4)]
+    _, X = dyadic_booster(seed=40)
+    before = _packed_fn()._cache_size()
+    with Server(min_bucket=4, max_bucket=64, pack_size=8) as srv:
+        srv.load_pack("cc", members)
+        rng = np.random.RandomState(0)
+        for _ in range(40):
+            nm = members[rng.randint(len(members))][0]
+            rows = int(rng.randint(1, 100))
+            srv.predict(nm, X[:rows], raw_score=True)
+        snap = srv.metrics_snapshot()["packs"]["cc"]
+    grown = _packed_fn()._cache_size() - before
+    bound = max_compilations(64)
+    assert grown <= bound, \
+        f"fused kernel compiled {grown} times (> ladder bound {bound})"
+    assert snap["compile_count"] <= bound
+    assert snap["fused_dispatches"] >= 40
+
+
+# ---------------------------------------------------------------------------
+# continuous-batching scheduler
+
+
+def _mk_req(rows, tag):
+    return np.full((rows, 2), tag, np.int32)
+
+
+def test_slo_scheduler_skip_and_fill_interleaves():
+    """A queued request that doesn't fit the forming batch is skipped
+    and later, smaller requests backfill around it; fifo stays a
+    strict prefix and never interleaves."""
+    dispatched = []
+
+    def run(bins):
+        dispatched.append(sorted(set(int(v) for v in bins[:, 0])))
+        return np.zeros((len(bins), 1), np.float32)
+
+    mb = MicroBatcher(run, max_batch_size=8, max_wait_ms=5.0,
+                      scheduler="slo")
+    try:
+        mb.pause()
+        now = time.monotonic()
+        f1 = mb.submit(_mk_req(4, 1), deadline=now + 10.0)  # loose
+        f2 = mb.submit(_mk_req(6, 2), deadline=now + 2.0)   # tight
+        f3 = mb.submit(_mk_req(2, 3), deadline=now + 10.0)  # loose, small
+        mb.resume()
+        for f in (f1, f2, f3):
+            f.result(timeout=10.0)
+    finally:
+        mb.close()
+    # tightest budget first; the 4-row loose request can't join its
+    # batch (6+4 > 8) so the 2-row one jumps it
+    assert dispatched[0] == [2, 3]
+    assert dispatched[1] == [1]
+    assert mb.interleave_count == 1
+
+
+def test_fifo_scheduler_is_a_strict_prefix():
+    dispatched = []
+
+    def run(bins):
+        dispatched.append(sorted(set(int(v) for v in bins[:, 0])))
+        return np.zeros((len(bins), 1), np.float32)
+
+    mb = MicroBatcher(run, max_batch_size=8, max_wait_ms=5.0,
+                      scheduler="fifo")
+    try:
+        mb.pause()
+        now = time.monotonic()
+        futs = [mb.submit(_mk_req(4, 1), deadline=now + 10.0),
+                mb.submit(_mk_req(6, 2), deadline=now + 2.0),
+                mb.submit(_mk_req(2, 3), deadline=now + 10.0)]
+        mb.resume()
+        for f in futs:
+            f.result(timeout=10.0)
+    finally:
+        mb.close()
+    # arrival order, batch cut where the next request stops fitting
+    assert dispatched[0] == [1]
+    assert dispatched[1] == [2, 3]
+    assert mb.interleave_count == 0
+
+
+# ---------------------------------------------------------------------------
+# rows-aware admission (the EMA regression that motivated _ServiceModel)
+
+
+def test_service_model_is_rows_aware():
+    """Alternating 1024-row/1s and 8-row/10ms observations must NOT
+    collapse into one scalar mean: the fitted linear model projects
+    small dispatches cheap and large ones expensive."""
+    svc = _ServiceModel(seed_s=0.002)
+    for _ in range(30):
+        svc.update(1024, 1.0)
+        svc.update(8, 0.01)
+    assert svc.projected(8) < 0.1, \
+        "small-batch projection inherited the large-batch wall"
+    assert svc.projected(1024) > 0.5
+    # a scalar EMA would sit near the midpoint for both
+    assert svc.projected(1024) > 5 * svc.projected(8)
+
+
+def test_poisoned_estimate_cannot_death_spiral():
+    """A cold-start compile poisons the service estimate; since sheds
+    never dispatch (and so never refresh it), an empty queue must
+    always admit — otherwise the model starves of the samples that
+    would correct it. A non-empty queue still projects honestly."""
+    fake = [100.0]
+
+    def clock():
+        return fake[0]
+
+    def run(bins):
+        return np.zeros((len(bins), 1), np.float32)
+
+    mb = MicroBatcher(run, max_batch_size=64, max_wait_ms=2.0,
+                      scheduler="slo", clock=clock)
+    try:
+        mb.pause()
+        mb._svc.update(64, 10.0)   # 10s "compile" observation
+        # empty queue + 5ms budget: admits despite the 10s estimate
+        mb.submit(_mk_req(4, 1), deadline=fake[0] + 0.005)
+        assert mb.deadline_shed_count == 0
+        # queue now non-empty: the same tight budget projects through
+        # the poisoned model and sheds at admission
+        with pytest.raises(DeadlineExceeded):
+            mb.submit(_mk_req(4, 2), deadline=fake[0] + 0.005)
+        assert mb.deadline_shed_count == 1
+    finally:
+        mb.close(drain_queued=False)
+
+
+def test_admission_ignores_looser_deadline_queue_rows():
+    """slo-mode admission only counts queued rows whose deadline is at
+    least as tight as the incoming request — work scheduled BEHIND it
+    cannot delay it, so it must not shed it either."""
+    fake = [100.0]
+
+    def run(bins):
+        return np.zeros((len(bins), 1), np.float32)
+
+    mb = MicroBatcher(run, max_batch_size=64, max_wait_ms=2.0,
+                      scheduler="slo", clock=lambda: fake[0])
+    try:
+        mb.pause()
+        mb._svc.update(64, 10.0)
+        mb.submit(_mk_req(32, 1))                       # deadline-free
+        mb.submit(_mk_req(32, 2), deadline=fake[0] + 60.0)  # loose
+        # both queued rows sort behind a tight arrival: admits
+        mb.submit(_mk_req(4, 3), deadline=fake[0] + 0.005)
+        assert mb.deadline_shed_count == 0
+    finally:
+        mb.close(drain_queued=False)
+
+
+# ---------------------------------------------------------------------------
+# pack lifecycle: evict drains queued futures to host, exactly once
+
+
+def test_pack_member_evict_drains_queued_to_host_exactly_once():
+    members = [(f"m{i}", dyadic_booster(trees=8, seed=50 + i)[0])
+               for i in range(3)]
+    boosters = dict(members)
+    _, X = dyadic_booster(seed=50)
+    with Server(min_bucket=4, max_bucket=64, pack_size=4) as srv:
+        srv.load_pack("lp", members)
+        for nm, _ in members:
+            srv.predict(nm, X[:8], raw_score=True)   # warm
+        ents = {nm: srv.registry.get(nm) for nm, _ in members}
+        base_reqs = {nm: ents[nm].metrics.snapshot()["requests"]
+                     for nm in ents}
+        srv.batcher("m0").pause()
+        f_evicted = srv.predict_async("m0", X[:5], raw_score=True)
+        f_survivor = srv.predict_async("m1", X[:7], raw_score=True)
+        assert srv.batcher("m0").queue_depth() == 2
+        srv.evict_model("m0")
+        # both queued futures resolve through host predict of the
+        # entry captured at submit — bit-equal (dyadic), exactly once
+        assert np.array_equal(f_evicted.result(timeout=10.0),
+                              boosters["m0"].predict(X[:5],
+                                                     raw_score=True))
+        assert np.array_equal(f_survivor.result(timeout=10.0),
+                              boosters["m1"].predict(X[:7],
+                                                     raw_score=True))
+        for nm, extra in (("m0", 1), ("m1", 1)):
+            s = ents[nm].metrics.snapshot()
+            assert s["requests"] == base_reqs[nm] + extra
+            assert s["fallback_count"] == 1
+        # the pack rebuilt for the survivors and stays on the fused
+        # path: new version, m0 gone, fused dispatches still growing
+        snap = srv.metrics_snapshot()
+        psnap = snap["packs"]["lp"]
+        assert psnap["version"] == 2
+        assert "m0" not in psnap["members"]
+        assert psnap["rebuild_drains"] == 2
+        assert snap["engine"]["pack_rebuilds"] == 1
+        before = psnap["fused_dispatches"]
+        got = srv.predict("m1", X[:9], raw_score=True)
+        assert np.array_equal(
+            got, boosters["m1"].predict(X[:9], raw_score=True))
+        assert srv.metrics_snapshot()["packs"]["lp"][
+            "fused_dispatches"] > before
+        assert "m0" not in srv.registry
+
+
+# ---------------------------------------------------------------------------
+# fault site + chaos under load
+
+
+@pytest.mark.faults
+def test_pack_fault_site_retries_inside_replica_bracket():
+    """`serving_pack_predict` fires inside the replica retry bracket:
+    one injected fault is retried transparently and the answer stays
+    bit-identical."""
+    members = [(f"m{i}", dyadic_booster(trees=8, seed=60 + i)[0])
+               for i in range(2)]
+    boosters = dict(members)
+    _, X = dyadic_booster(seed=60)
+    faults.clear("serving_pack_predict")
+    with Server(min_bucket=4, max_bucket=64, pack_size=4,
+                retry_attempts=2, retry_backoff_ms=1.0) as srv:
+        srv.load_pack("ft", members)
+        srv.predict("m0", X[:8], raw_score=True)     # warm
+        with faults.injected("serving_pack_predict", fail=1):
+            got = srv.predict("m1", X[:12], raw_score=True)
+        assert np.array_equal(
+            got, boosters["m1"].predict(X[:12], raw_score=True))
+        assert faults.trips("serving_pack_predict") == 1
+        assert srv.metrics_snapshot()["packs"]["ft"][
+            "device_retries"] >= 1
+
+
+@pytest.mark.serve_chaos
+def test_pack_chaos_swap_and_faults_under_load():
+    """Open-loop load over every pack member while `serving_pack_predict`
+    faults fire and one member is hot-swapped: zero drops, every answer
+    bit-equal to SOME published version of its model."""
+    members = [(f"m{i}", dyadic_booster(trees=8 + 4 * i,
+                                        seed=70 + i)[0])
+               for i in range(3)]
+    boosters = dict(members)
+    swapped_v2, _ = dyadic_booster(trees=10, seed=99)
+    _, X = dyadic_booster(seed=70)
+    names = [nm for nm, _ in members]
+    faults.clear("serving_pack_predict")
+
+    with Server(min_bucket=4, max_bucket=128, max_wait_ms=1.0,
+                max_queue=1024, n_replicas=2, retry_attempts=2,
+                retry_backoff_ms=1.0, pack_size=4) as srv:
+        srv.load_pack("cp", members)
+        for nm in names:
+            for rows in (4, 16, 64):
+                srv.predict(nm, X[:rows], raw_score=True)  # warm ladder
+
+        def mid(stage):
+            faults.schedule("serving_pack_predict", fail=2)
+            srv.hot_swap("m1", booster=swapped_v2)
+
+        res = run_open_loop(srv, names[0], X,
+                            stages=[(150, 1.0), (150, 1.0)],
+                            max_rows=16, raw_score=True,
+                            timeout_s=30.0, seed=5, mid_run=mid,
+                            names=names)
+        faults.clear("serving_pack_predict")
+        snap = srv.metrics_snapshot()
+
+    assert res.dropped == 0, f"outcomes: {res.by_outcome()}"
+    # m1 answers may come from either published version; the rest are
+    # single-version and checked via the ledger helper
+    old_m1 = boosters.pop("m1")
+    for rec in [r for r in res.ok_records() if r.model == "m1"]:
+        ref_old = old_m1.predict(X[rec.lo:rec.hi], raw_score=True)
+        ref_new = swapped_v2.predict(X[rec.lo:rec.hi], raw_score=True)
+        got = np.asarray(rec.value)
+        assert np.array_equal(got, ref_old) or \
+            np.array_equal(got, ref_new), \
+            f"request {rec.idx}: m1 answer matches neither version"
+    rest = LoadResult(
+        records=[r for r in res.records if r.model != "m1"],
+        wall_s=res.wall_s)
+    assert verify_bit_identical(rest, None, X, boosters=boosters) > 0
+    assert snap["packs"]["cp"]["version"] >= 2
+    assert snap["engine"]["pack_rebuilds"] >= 1

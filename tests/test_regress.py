@@ -162,6 +162,40 @@ def test_serve_series_regressions_flagged(tmp_path):
     assert result["serve_records"] == 3
 
 
+def test_multimodel_packed_qps_drop_flagged(tmp_path):
+    """The serve record's packed multi-model QPS column is its own
+    tracked series: a >10% drop in mm_packed_qps fires even when the
+    headline single-model QPS holds steady."""
+    rec = lambda mm: _bench_rec(800.0, mm_packed_qps=mm,
+                                metric="serve_sustained_qps_p99lt10ms")
+    _write(tmp_path, "SERVE_r01.json", rec(400.0))
+    _write(tmp_path, "SERVE_r02.json", rec(250.0))       # -37.5%
+    result = regress.compare(str(tmp_path))
+    (reg,) = result["regressions"]
+    assert reg["metric"] == \
+        "serve:serve_sustained_qps_p99lt10ms:mm_packed_qps"
+    assert reg["best"] == 400.0
+
+
+def test_multimodel_speedup_within_threshold_quiet(tmp_path):
+    """mm_packed_speedup is tracked alongside mm_packed_qps but small
+    wobble stays quiet; rounds without the multi-model stage simply
+    contribute no sample (no false regression from a missing column)."""
+    _write(tmp_path, "SERVE_r01.json",
+           _bench_rec(800.0, mm_packed_qps=400.0, mm_packed_speedup=1.5,
+                      metric="serve_sustained_qps_p99lt10ms"))
+    _write(tmp_path, "SERVE_r02.json",      # no mm stage this round
+           _bench_rec(810.0, metric="serve_sustained_qps_p99lt10ms"))
+    _write(tmp_path, "SERVE_r03.json",
+           _bench_rec(805.0, mm_packed_qps=390.0, mm_packed_speedup=1.45,
+                      metric="serve_sustained_qps_p99lt10ms"))
+    result = regress.compare(str(tmp_path))
+    assert result["regressions"] == []
+    spd = result["metrics"][
+        "serve:serve_sustained_qps_p99lt10ms:mm_packed_speedup"]
+    assert spd["samples"] == 2 and spd["latest"] == 1.45
+
+
 def test_multichip_flip_is_a_regression(tmp_path):
     mc = {"n_devices": 2, "rc": 0, "ok": True, "skipped": False}
     _write(tmp_path, "MULTICHIP_r01.json", mc)
