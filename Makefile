@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-all faults chaos postmortem distributed observe lint lint-sarif pipeline kernels perf stream bench serve-chaos serve-bench loop loop-chaos install
+.PHONY: test test-slow test-all faults chaos postmortem distributed observe lint lint-sarif pipeline kernels perf stream bench serve-chaos serve-bench loop loop-chaos elastic install
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -85,6 +85,17 @@ postmortem:
 distributed:
 	$(PY) -m pytest tests/test_distributed_learner.py -x -q -m "distributed and not slow"
 	$(PY) -m pytest tests/test_distributed_learner.py -x -q -m "distributed and slow"
+
+# the elastic world-resize tier (docs/Distributed.md "Elasticity"):
+# the fast subset (tier-1, no subprocesses) covers epoch agreement,
+# the reshard loader's W->W'->W byte-identity, stale-epoch rejection
+# and the shrink-vote state machine; the slow invocation runs the
+# shrink-and-finish reincarnation scenario — kill a rank at 2x4
+# devices, survivors vote a new epoch, re-shard, finish with zero
+# aborts, byte-identical to a fixed-world resume
+elastic:
+	$(PY) -m pytest tests/test_elastic.py -x -q -m "elastic and not slow"
+	$(PY) -m pytest tests/test_elastic.py -x -q -m "elastic and slow"
 
 # the serving chaos tier: concurrent load while the fault registry
 # kills replica dispatches, breakers trip/heal, and the model is

@@ -461,7 +461,7 @@ def _multichip_worker_main(argv):
     train_s = time.perf_counter() - t0
     dist = _obs.distributed_snapshot()
     rate = n_trees / train_s if train_s > 0 else 0.0
-    print(json.dumps({
+    rec = {
         "n_devices": ndev, "tree_learner": "data",
         "trees_per_sec": round(rate, 3),
         "vs_baseline": round(rate / BASELINE_TREES_PER_SEC, 3),
@@ -470,7 +470,17 @@ def _multichip_worker_main(argv):
         "ingest_s": round(ingest_s, 3),
         "train_s": round(train_s, 3),
         "world": dist["world"],
-        "feature_shard_width": dist["feature_shard_width"]}))
+        "feature_shard_width": dist["feature_shard_width"]}
+    # elasticity cost (docs/Distributed.md "Elasticity"): when this
+    # round resized mid-run, the sentinel tracks the post-resize
+    # throughput and reshard wall alongside the main series
+    mem = _obs.membership_snapshot()
+    if mem.get("resizes", 0):
+        rec["chaos_resize"] = {
+            "resizes": int(mem["resizes"]),
+            "reshard_wall_s": float(mem["reshard_wall_s"]),
+            "post_resize_trees_per_sec": round(rate, 3)}
+    print(json.dumps(rec))
     sys.stdout.flush()
     return 0
 

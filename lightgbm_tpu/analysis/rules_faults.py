@@ -40,6 +40,7 @@ DISPATCH_MANIFEST = (
     ("trainer.py", "_publish", "loop_publish"),
     ("comm.py", "guarded_allgather", "collective_psum"),
     ("hist_agg.py", "build_feature_shards", "distributed_hist_agg"),
+    ("elastic.py", "propose_shrink", "elastic_resize"),
 )
 
 #: wrapper function -> the site its body injects
@@ -65,6 +66,7 @@ _DIR_HINTS = {
     ("trainer.py", "_publish"): "continuous",
     ("comm.py", "guarded_allgather"): "parallel",
     ("hist_agg.py", "build_feature_shards"): "distributed",
+    ("elastic.py", "propose_shrink"): "distributed",
 }
 
 

@@ -331,6 +331,15 @@ COLLECTIVE_MANIFEST = (
      "collective_psum", "dispatch", ("test_distributed_learner.py",)),
     ("binning.py", "distributed", "merge_streaming_sketch",
      "collective_psum", "delegate", ("test_distributed_learner.py",)),
+    # elastic membership (distributed/elastic.py): the epoch-agreement
+    # gather and the reshard row-count exchange both delegate to
+    # guarded_allgather (the shrink VOTE itself is deliberately NOT a
+    # collective — it rides the heartbeat directory because the old
+    # world's collectives just failed)
+    ("elastic.py", "distributed", "epoch_agree", "collective_psum",
+     "delegate", ("test_elastic.py",)),
+    ("elastic.py", "distributed", "reshard_offsets", "collective_psum",
+     "delegate", ("test_elastic.py",)),
 )
 
 

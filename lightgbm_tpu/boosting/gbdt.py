@@ -1816,6 +1816,9 @@ class GBDT:
             Log.warning(
                 "cegb feature-used state is not checkpointed; resumed "
                 "CEGB penalties restart from a clean slate")
+        if state.get("reshard_total_rows") is not None:
+            arrays = self._reshard_restore_arrays(
+                int(state["reshard_total_rows"]), arrays)
         score = jnp.asarray(arrays["train_score"])
         if score.shape != self.train_score.shape:
             raise LightGBMError(
@@ -1840,6 +1843,42 @@ class GBDT:
             key = f"valid_score_{i}"
             if key in arrays:
                 self.valid_scores[i] = jnp.asarray(arrays[key])
+
+    def _reshard_restore_arrays(self, total_rows: int,
+                                arrays: Dict) -> Dict:
+        """Elastic resume (distributed/elastic.py): the resharded
+        loader handed every rank the GLOBAL row-order arrays of a
+        bundle written by a DIFFERENT world size; slice this rank's
+        contiguous row block so the shape check below sees the same
+        local arrays an uninterrupted run at this world would hold.
+        Valid sets are row-partitioned too but on their own totals, so
+        each gets its own offset exchange."""
+        from ..distributed.elastic import reshard_offsets, reshard_slice
+        local = int(self.num_data)
+        offset, tot = reshard_offsets(local, label="elastic_reshard")
+        if tot != int(total_rows):
+            raise LightGBMError(
+                "elastic reshard: checkpoint holds %d global training "
+                "rows but the new world's partitions sum to %d — the "
+                "reincarnated run loaded a different dataset" %
+                (int(total_rows), tot))
+        valid = {k: v for k, v in arrays.items()
+                 if k.startswith("valid_score_")}
+        out = reshard_slice(
+            {k: v for k, v in arrays.items() if k not in valid},
+            offset, local, tot)
+        for i, s in enumerate(getattr(self, "valid_scores", []) or []):
+            key = f"valid_score_{i}"
+            if key not in valid:
+                continue
+            varr = np.asarray(valid[key])
+            vlocal = int(np.asarray(s).shape[0])
+            voff, vtot = reshard_offsets(
+                vlocal, label="elastic_reshard_valid")
+            if varr.ndim and varr.shape[0] == vtot:
+                varr = varr[voff:voff + vlocal]
+            out[key] = varr
+        return out
 
 
 def create_boosting(config: Config, train_set, objective, metrics):

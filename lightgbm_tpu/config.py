@@ -319,6 +319,25 @@ _PARAMS: List[ParamSpec] = [
             "files; defaults to <checkpoint_dir>/heartbeats when a "
             "checkpoint_dir is set, else heartbeat diagnosis is "
             "disabled (deadline aborts still fire, unnamed)"),
+    _p("elastic_resize", bool, False, (),
+       desc="when the collective watchdog names a dead rank, survivors "
+            "vote a mesh shrink through the heartbeat directory, commit "
+            "a new membership epoch, and exit for reincarnation at the "
+            "smaller world instead of aborting (exit 75, not 113); the "
+            "relaunched ranks re-shard rows from the epoch checkpoint "
+            "and finish the run (docs/Distributed.md Elasticity). "
+            "Default false preserves the abort-on-death behavior "
+            "bit-for-bit. Requires heartbeat_dir (or checkpoint_dir) "
+            "and a supervisor that relaunches on exit code 75"),
+    _p("elastic_min_world", int, 1, (), lambda v: v >= 1,
+       desc="smallest world size an elastic shrink may commit; a "
+            "failure that would leave fewer survivors falls back to "
+            "the watchdog abort so the supervisor can restart the full "
+            "fleet instead of limping on too few chips"),
+    _p("elastic_epoch_timeout_s", float, 30.0, (), lambda v: v >= 0,
+       desc="how long a survivor waits for all peers' shrink proposals "
+            "to agree before giving up on the vote and falling back to "
+            "the watchdog abort"),
     _p("checkpoint_coordinated", bool, True, (),
        desc="multihost checkpointing runs the coordinated commit "
             "protocol (iteration agreement, per-rank shards, COMMIT "

@@ -21,13 +21,23 @@ layer (src/network/). Here:
 - ``fused``: the row-sharded fused multi-tree scan — the boosting loop
   of `boosting/fused.py` inside `shard_map`, so K sharded trees cost
   one device dispatch and compose with the pipelined executor.
+- ``elastic``: the membership-epoch protocol that turns a rank-death
+  abort into a mesh shrink — survivors vote through the heartbeat
+  directory, commit a new epoch, and reincarnate at the smaller world
+  (docs/Distributed.md "Elasticity").
 """
 
 from .crossbar import (CROSSBAR, LearnerSpec, create_tree_learner,
                        resolve_learner)
+from .elastic import (ELASTIC_RESIZE_EXIT_CODE, MembershipRecord,
+                      current_epoch, epoch_agree, load_membership,
+                      propose_shrink, request_join)
 from .hist_agg import (build_feature_shards, check_hist_agg_fault,
                        reduce_scatter_hist)
 
 __all__ = ["CROSSBAR", "LearnerSpec", "create_tree_learner",
            "resolve_learner", "build_feature_shards",
-           "check_hist_agg_fault", "reduce_scatter_hist"]
+           "check_hist_agg_fault", "reduce_scatter_hist",
+           "ELASTIC_RESIZE_EXIT_CODE", "MembershipRecord",
+           "current_epoch", "epoch_agree", "load_membership",
+           "propose_shrink", "request_join"]

@@ -118,6 +118,25 @@ def create_tree_learner(spec: LearnerSpec, mesh, comm, **kwargs
     cell's device row selecting the MXU or portable body."""
     if not spec.is_parallel:
         return None
+    _record_epoch_resolve(spec)
     from ..parallel.learner import make_sharded_grower
     return make_sharded_grower(mesh, comm, use_mxu=spec.device == "mxu",
                                **kwargs)
+
+
+def _record_epoch_resolve(spec: LearnerSpec) -> None:
+    """Elastic reincarnation re-resolves the learner through this same
+    crossbar at the shrunken world; leave a flight-recorder breadcrumb
+    when that happens (epoch > 0) so a postmortem shows which cell the
+    resized run landed on. Never raises — forensics must not block the
+    factory."""
+    try:
+        from .elastic import current_epoch
+        epoch = current_epoch()
+        if epoch > 0:
+            from ..observability.flightrec import recorder
+            recorder.record("resize", "crossbar_resolve", epoch=epoch,
+                            mode=spec.mode, device=spec.device,
+                            hist_agg=spec.hist_agg)
+    except Exception:       # pragma: no cover - forensics only
+        pass

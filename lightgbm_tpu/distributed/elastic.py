@@ -1,0 +1,446 @@
+"""Elastic world resize: membership epochs over the guarded collectives.
+
+The PR-9 survivability story ends every rank-death incident the same
+way: the watchdog diagnoses "rank k last seen Ns ago" and every
+survivor ``os._exit(113)``s — the run dies even though the data, the
+checkpoint and most of the chips are fine. This module turns that abort
+into a *resize*: survivors agree on a smaller world, drain to the last
+coordinated checkpoint, and finish the run.
+
+Design constraint that shapes everything here: a rank blocked inside a
+gloo/ICI collective CANNOT be interrupted from Python — the watchdog
+monitor is a daemon thread and the main thread is stuck in C until the
+process dies. True in-process mesh surgery is therefore impossible; the
+protocol is Torch-Elastic-style **process reincarnation** instead:
+
+1. the `CollectiveGuard` deadline fires with ``elastic_resize=true``;
+   the abort path calls `propose_shrink` instead of exiting 113;
+2. each fresh survivor names the dead ranks from the same stale
+   heartbeats the abort diagnosis uses, and writes a *shrink proposal*
+   (``resize_epoch_%04d_rank_%03d.json``) into the heartbeat directory
+   — deliberately NOT a collective: the old world's collectives are
+   the thing that just failed, so the vote rides the shared filesystem
+   the heartbeats already prove works;
+3. when every fresh survivor's proposal agrees on the member list, the
+   lowest surviving rank commits ``membership_epoch_%04d.json`` — the
+   new epoch, the new world size, the survivor->new-rank renumbering
+   and the checkpoint bundle to resume from. Parked joiners
+   (``join_*.json``) are admitted at this epoch cut and extend the
+   member plan;
+4. every survivor exits with `ELASTIC_RESIZE_EXIT_CODE` (75 — a
+   voluntary reincarnation, distinct from the watchdog abort 113 and
+   the injected rank death 86). A supervisor (`testing/chaos.py`
+   ``run_elastic_training``, or any orchestrator watching exit codes)
+   relaunches the survivors at the new world size with contiguous
+   ranks and ``LIGHTGBM_TPU_EPOCH`` set;
+5. the reincarnated processes re-init jax.distributed at W', re-resolve
+   the learner through the crossbar, load the W-rank bundle through the
+   reshard loader (`reliability/checkpoint.py
+   load_checkpoint_resharded`), slice their contiguous row block via
+   `reshard_offsets`, and resume boosting at the exact iteration.
+
+Stale-epoch rejection: every `guarded_allgather` piggybacks
+`current_epoch` on the same wire as its payload (parallel/comm.py); a
+zombie rank from a previous epoch that finds its way into a collective
+trips `check_epoch_agreement` on every rank instead of silently
+corrupting the gather.
+
+Observability: the ``lightgbm_tpu_membership`` family (epoch, world,
+resizes, joins, reshard_wall_s — observability/registry.py) plus
+flight-recorder ``resize`` events at the vote, the commit and the
+crossbar re-resolve.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import asdict, dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.log import Log, LightGBMError
+
+__all__ = [
+    "ELASTIC_RESIZE_EXIT_CODE", "MembershipRecord", "current_epoch",
+    "set_epoch", "reset_epoch", "check_epoch_agreement", "epoch_agree",
+    "reshard_offsets", "reshard_slice", "plan_resize", "propose_shrink",
+    "request_join", "list_joiners", "load_membership",
+    "sweep_stale_epoch_files",
+]
+
+#: exit status of a rank leaving voluntarily to be reincarnated at the
+#: new world size — distinct from the watchdog abort (113) and the
+#: injected rank death (86), so supervisors and chaos tests can tell
+#: "relaunch me smaller" from "something went wrong"
+ELASTIC_RESIZE_EXIT_CODE = 75
+
+_MEMBER_PREFIX = "membership_epoch_"
+_PROPOSAL_PREFIX = "resize_epoch_"
+_JOIN_PREFIX = "join_"
+_HB_PREFIX = "hb_rank_"
+
+
+# ----------------------------------------------------------------------
+# membership-epoch state: one integer per process, seeded from the
+# supervisor's LIGHTGBM_TPU_EPOCH on first read so reincarnated workers
+# wake up already in the committed epoch
+
+_state_lock = threading.Lock()
+_epoch: Optional[int] = None
+
+
+def current_epoch() -> int:
+    """This process's membership epoch (0 = the original world)."""
+    global _epoch
+    with _state_lock:
+        if _epoch is None:
+            _epoch = int(os.environ.get("LIGHTGBM_TPU_EPOCH", "0") or 0)
+        return _epoch
+
+
+def set_epoch(epoch: int) -> None:
+    global _epoch
+    with _state_lock:
+        _epoch = int(epoch)
+
+
+def reset_epoch() -> None:
+    """Forget the cached epoch (tests): the next `current_epoch` re-seeds
+    from the environment."""
+    global _epoch
+    with _state_lock:
+        _epoch = None
+
+
+def check_epoch_agreement(epochs, label: str = "collective") -> None:
+    """Stale-epoch rejection: every participant of a collective must be
+    in the same membership epoch, and it must be THIS process's epoch.
+    A zombie from a pre-resize world that wanders into a barrier
+    corrupts the gather silently; this turns it into a named error on
+    every rank (rank-uniform data, so all ranks raise together)."""
+    seen = sorted({int(e) for e in epochs})
+    if len(seen) > 1:
+        raise LightGBMError(
+            f"collective '{label}': participants span membership epochs "
+            f"{seen} — a rank from a stale world joined the barrier; "
+            f"restart it at the committed epoch")
+    if seen and seen[0] != current_epoch():
+        raise LightGBMError(
+            f"collective '{label}': wire epoch {seen[0]} does not match "
+            f"this process's membership epoch {current_epoch()}")
+
+
+def epoch_agree(label: str = "elastic_epoch_agree") -> int:
+    """Startup barrier of a (re)incarnated world: every rank contributes
+    its membership epoch through the guarded allgather (inheriting the
+    `collective_psum` fault site and the watchdog bracket) and all must
+    agree. Returns the agreed epoch."""
+    from ..parallel.comm import guarded_allgather
+    epochs = np.asarray(guarded_allgather(
+        np.asarray([current_epoch()], dtype=np.int64),
+        label=label)).reshape(-1)
+    check_epoch_agreement([int(e) for e in epochs], label=label)
+    return int(epochs[0])
+
+
+# ----------------------------------------------------------------------
+# re-shard: a W-rank bundle's global arrays sliced into W' contiguous
+# row blocks
+
+def reshard_offsets(local_rows: int, label: str = "elastic_reshard"
+                    ) -> Tuple[int, int]:
+    """(row offset, total rows) of this rank's contiguous block in the
+    new world's global row order — an allgather of every rank's local
+    row count (the re-shard collective; delegates to
+    `guarded_allgather` so it carries the fault site and the watchdog
+    bracket). Degenerates to (0, local_rows) on one process."""
+    import jax
+    from ..parallel.comm import guarded_allgather
+    counts = np.asarray(guarded_allgather(
+        np.asarray([int(local_rows)], dtype=np.int64),
+        label=label)).reshape(-1)
+    rank = jax.process_index()
+    return int(counts[:rank].sum()), int(counts.sum())
+
+
+def reshard_slice(arrays: Dict[str, np.ndarray], offset: int,
+                  local_rows: int, total_rows: int
+                  ) -> Dict[str, np.ndarray]:
+    """Slice this rank's contiguous row block out of globally
+    concatenated checkpoint arrays: every array whose leading dimension
+    equals `total_rows` is row-partitioned state (train_score,
+    bag_mask); everything else (rng_key — identical on all ranks) is
+    passed through."""
+    out: Dict[str, np.ndarray] = {}
+    for key, val in arrays.items():
+        a = np.asarray(val)
+        if key != "rng_key" and a.ndim and a.shape[0] == int(total_rows):
+            out[key] = a[int(offset):int(offset) + int(local_rows)]
+        else:
+            out[key] = a
+    return out
+
+
+# ----------------------------------------------------------------------
+# membership files: the heartbeat directory as the shared medium
+
+def _write_json_atomic(path: str, obj: Dict) -> None:
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def _read_json(path: str) -> Optional[Dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _listdir(path: str) -> List[str]:
+    try:
+        return os.listdir(path)
+    except (FileNotFoundError, NotADirectoryError):
+        return []
+
+
+@dataclass(frozen=True)
+class MembershipRecord:
+    """One committed epoch cut: who the new world is and where it
+    resumes. `members` are OLD-world ranks in ascending order — a
+    survivor's new rank is its index in that list; admitted joiners
+    take the ranks after the survivors."""
+    epoch: int
+    world: int
+    members: Tuple[int, ...]
+    joiners: Tuple[str, ...] = ()
+    reason: str = ""
+    resume_bundle: str = ""
+
+    def new_rank(self, old_rank: int) -> Optional[int]:
+        try:
+            return self.members.index(int(old_rank))
+        except ValueError:
+            return None
+
+
+def _member_path(heartbeat_dir: str, epoch: int) -> str:
+    return os.path.join(heartbeat_dir, f"{_MEMBER_PREFIX}{epoch:04d}.json")
+
+
+def _proposal_path(heartbeat_dir: str, epoch: int, rank: int) -> str:
+    return os.path.join(
+        heartbeat_dir, f"{_PROPOSAL_PREFIX}{epoch:04d}_rank_{rank:03d}.json")
+
+
+def load_membership(heartbeat_dir: str,
+                    epoch: Optional[int] = None
+                    ) -> Optional[MembershipRecord]:
+    """The committed membership record for `epoch`, or the latest one
+    when `epoch` is None; None when nothing has been committed."""
+    best: Optional[Tuple[int, Dict]] = None
+    for name in _listdir(heartbeat_dir):
+        if not (name.startswith(_MEMBER_PREFIX) and name.endswith(".json")):
+            continue
+        try:
+            ep = int(name[len(_MEMBER_PREFIX):-len(".json")])
+        except ValueError:
+            continue
+        if epoch is not None and ep != int(epoch):
+            continue
+        rec = _read_json(os.path.join(heartbeat_dir, name))
+        if rec is None:
+            continue
+        if best is None or ep > best[0]:
+            best = (ep, rec)
+    if best is None:
+        return None
+    ep, rec = best
+    return MembershipRecord(
+        epoch=int(rec.get("epoch", ep)),
+        world=int(rec.get("world", 0)),
+        members=tuple(int(m) for m in rec.get("members", ())),
+        joiners=tuple(str(j) for j in rec.get("joiners", ())),
+        reason=str(rec.get("reason", "")),
+        resume_bundle=str(rec.get("resume_bundle", "")))
+
+
+def request_join(heartbeat_dir: str, token: str,
+                 now: Optional[float] = None) -> str:
+    """Park a prospective rank on the heartbeat directory. The file is
+    a standing request: it is folded into the member plan at the next
+    epoch cut (shrink OR an explicit cycle-boundary resize) and removed
+    by the supervisor once the joiner has been launched."""
+    os.makedirs(heartbeat_dir, exist_ok=True)
+    path = os.path.join(heartbeat_dir, f"{_JOIN_PREFIX}{token}.json")
+    _write_json_atomic(path, {
+        "token": str(token),
+        "stamp": float(time.time() if now is None else now)})
+    return path
+
+
+def list_joiners(heartbeat_dir: str) -> List[str]:
+    """Tokens of every parked join request, sorted (deterministic rank
+    assignment: joiners take new ranks after the survivors, in token
+    order)."""
+    out = []
+    for name in _listdir(heartbeat_dir):
+        if name.startswith(_JOIN_PREFIX) and name.endswith(".json"):
+            out.append(name[len(_JOIN_PREFIX):-len(".json")])
+    return sorted(out)
+
+
+def sweep_stale_epoch_files(heartbeat_dir: str, epoch: int,
+                            world: int) -> None:
+    """Restart hygiene (watchdog re-arm): a reincarnated W'-rank world
+    inherits the heartbeat directory of the W-rank world it shrank
+    from. Heartbeats of ranks that no longer exist would age into
+    permanent "rank k last seen Ns ago" culprits, and consumed shrink
+    proposals from committed epochs would confuse the next vote — both
+    are swept. Committed membership records are kept: they are the
+    durable history a late supervisor reads. Idempotent and safe to run
+    from every rank (ENOENT races are benign)."""
+    for name in _listdir(heartbeat_dir):
+        path = os.path.join(heartbeat_dir, name)
+        doomed = False
+        if name.startswith(_HB_PREFIX):
+            try:
+                doomed = int(name[len(_HB_PREFIX):]) >= int(world)
+            except ValueError:
+                doomed = name.endswith(".tmp") or ".tmp-" in name
+        elif name.startswith(_PROPOSAL_PREFIX) and name.endswith(".json"):
+            try:
+                ep = int(name[len(_PROPOSAL_PREFIX):].split("_", 1)[0])
+            except ValueError:
+                continue
+            doomed = ep <= int(epoch)
+        if doomed:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
+# ----------------------------------------------------------------------
+# the shrink vote
+
+def plan_resize(heartbeat_dir: str, rank: int, world: int, *,
+                stale_after_s: float, now: float
+                ) -> Tuple[List[int], List[int], List[str]]:
+    """(survivors, dead, joiners) from the heartbeat directory — the
+    same stale/missing diagnosis `CollectiveGuard.diagnose` prints,
+    turned into a member plan. This rank is always a survivor (it is
+    alive enough to be voting)."""
+    from ..reliability.watchdog import read_heartbeats
+    stamps = read_heartbeats(heartbeat_dir)
+    survivors: List[int] = []
+    dead: List[int] = []
+    for r in range(int(world)):
+        if r == int(rank):
+            survivors.append(r)
+        elif r in stamps and (now - stamps[r]) <= stale_after_s:
+            survivors.append(r)
+        else:
+            dead.append(r)
+    return survivors, dead, list_joiners(heartbeat_dir)
+
+
+def propose_shrink(heartbeat_dir: str, *, rank: int, world: int,
+                   epoch: int, min_world: int = 1,
+                   timeout_s: float = 30.0,
+                   stale_after_s: float = 3.0, reason: str = "",
+                   resume_bundle: str = "",
+                   wall: Callable[[], float] = time.time,
+                   sleep: Callable[[float], None] = time.sleep
+                   ) -> Optional[MembershipRecord]:
+    """The resize entry point (FAULT001 site ``elastic_resize``): vote
+    a shrink through the heartbeat directory and return the committed
+    `MembershipRecord`, or None when the vote cannot succeed — the
+    caller (the watchdog abort path) then falls back to the plain
+    abort, so a failed resize is never worse than today's behavior.
+
+    Every fresh survivor writes a proposal naming the members it
+    observed; when all survivor proposals agree, the lowest surviving
+    rank commits the membership record and everyone else verifies it.
+    Returns None when: no rank is actually dead (all heartbeats fresh —
+    a wedged interconnect, not a membership failure), the surviving
+    world would drop below `min_world`, the survivor sets disagree, or
+    the vote times out."""
+    from ..observability.flightrec import recorder
+    from ..observability.registry import registry
+    from ..reliability import faults
+    faults.inject("elastic_resize")
+    now = wall()
+    survivors, dead, joiners = plan_resize(
+        heartbeat_dir, rank, world, stale_after_s=stale_after_s, now=now)
+    if not dead:
+        Log.warning("elastic resize: no stale peer heartbeat — not a "
+                    "membership failure; falling back to abort")
+        return None
+    new_world = len(survivors) + len(joiners)
+    if new_world < int(min_world):
+        Log.warning(
+            "elastic resize: surviving world %d (+%d joiners) is below "
+            "elastic_min_world=%d; falling back to abort",
+            len(survivors), len(joiners), min_world)
+        return None
+    new_epoch = int(epoch) + 1
+    recorder.record("resize", "propose", epoch=new_epoch, rank=int(rank),
+                    members=survivors, dead=dead, joiners=joiners)
+    _write_json_atomic(_proposal_path(heartbeat_dir, new_epoch, rank), {
+        "epoch": new_epoch, "from_rank": int(rank), "old_world": int(world),
+        "members": survivors, "joiners": joiners, "stamp": now})
+    deadline = now + float(timeout_s)
+    committed: Optional[MembershipRecord] = None
+    while True:
+        committed = load_membership(heartbeat_dir, epoch=new_epoch)
+        if committed is not None:
+            break
+        plans = {}
+        for r in survivors:
+            prop = _read_json(_proposal_path(heartbeat_dir, new_epoch, r))
+            if prop is not None:
+                plans[r] = (tuple(int(m) for m in prop.get("members", ())),
+                            tuple(str(j) for j in prop.get("joiners", ())))
+        if len(plans) == len(survivors):
+            if len(set(plans.values())) != 1:
+                Log.warning("elastic resize: survivor proposals disagree "
+                            "(%r); falling back to abort", plans)
+                return None
+            if int(rank) == min(survivors):
+                committed = MembershipRecord(
+                    epoch=new_epoch, world=new_world,
+                    members=tuple(survivors), joiners=tuple(joiners),
+                    reason=str(reason)[:300],
+                    resume_bundle=str(resume_bundle))
+                _write_json_atomic(
+                    _member_path(heartbeat_dir, new_epoch),
+                    asdict(committed))
+                break
+        if wall() >= deadline:
+            Log.warning("elastic resize: vote for epoch %d timed out "
+                        "after %.1fs (%d/%d proposals); falling back to "
+                        "abort", new_epoch, timeout_s, len(plans),
+                        len(survivors))
+            return None
+        sleep(0.05)
+    registry.record_membership_resize(
+        "shrink", committed.epoch, committed.world,
+        joined=len(committed.joiners))
+    recorder.record("resize", "commit", epoch=committed.epoch,
+                    world=committed.world, members=list(committed.members),
+                    joiners=list(committed.joiners),
+                    resume_bundle=committed.resume_bundle)
+    Log.warning(
+        "elastic resize: epoch %d committed — world %d -> %d, members "
+        "%s%s; exiting for reincarnation (exit %d)",
+        committed.epoch, world, committed.world, list(committed.members),
+        f" + joiners {list(committed.joiners)}" if committed.joiners
+        else "", ELASTIC_RESIZE_EXIT_CODE)
+    return committed

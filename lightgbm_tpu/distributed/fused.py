@@ -28,6 +28,15 @@ is expanded away before fusion on CPU, and neither bitcast roundtrips,
 LLVM-level contraction — so the engine's b=1 bit-parity note
 (engine.py) carries this documented exception for the sharded path.
 
+Elasticity note (distributed/elastic.py): this builder closes over a
+FIXED world — `comm.num_devices`, the row pad, and the feature-shard
+transpose (`bins_ft`) are all sized for the mesh at build time. A
+membership resize therefore never mutates a live builder; the
+reincarnated process rebuilds the whole stack (crossbar re-resolve →
+`build_feature_shards` → this builder) at the new world, and the epoch
+stamped on every guarded gather rejects any straggler still running a
+builder from the old membership.
+
 Objective handling: the built-in objectives close over [N] row state
 (label / weight / trans_label / y_signed / ...). Baking those into the
 scan as replicated constants would defeat the sharding, so every 1-D

@@ -121,9 +121,16 @@ def reduce_scatter_hist(hist: jax.Array, axis: str) -> jax.Array:
 
 def _record_setup(world: int, fp: int, wall_seconds: float) -> None:
     """Feed the lightgbm_tpu_distributed metric family; never raises —
-    telemetry must not fail the setup collective that carried it."""
+    telemetry must not fail the setup collective that carried it. When
+    this transpose runs in a reincarnated world (membership epoch > 0)
+    the wall is ALSO the feature-shard rebuild half of the resize cost,
+    so it folds into lightgbm_tpu_membership reshard_wall_s alongside
+    the row reshard the checkpoint loader timed."""
     try:
         from ..observability.registry import registry
         registry.record_distributed_setup(world, fp, wall_seconds)
+        from .elastic import current_epoch
+        if current_epoch() > 0:
+            registry.record_membership_reshard(wall_seconds)
     except Exception:       # pragma: no cover - telemetry only
         pass

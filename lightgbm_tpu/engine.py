@@ -63,16 +63,26 @@ def train(params: Dict[str, Any], train_set: Dataset,
         if init_model is not None:
             raise ValueError("resume_from and init_model are exclusive: "
                              "a checkpoint bundle already carries its model")
-        from .reliability.checkpoint import load_checkpoint
+        from .reliability.checkpoint import (load_checkpoint,
+                                             load_checkpoint_resharded,
+                                             bundle_world)
         # under multihost (setup_multihost ran before train, like the
         # reference CLI) each rank loads its own shard of a coordinated
-        # bundle; world validation rejects topology changes
+        # bundle; world validation rejects topology changes — unless
+        # elastic_resize is on, where a world mismatch is exactly the
+        # reincarnation path: every rank reads ALL shards of the old
+        # world's bundle and re-slices its own contiguous row block at
+        # restore time (docs/Distributed.md Elasticity)
         import jax
         try:
             _world = jax.process_count()
         except RuntimeError:
             _world = 1
-        if _world > 1:
+        _elastic = bool(params.get("elastic_resize", False))
+        _bundle_world = bundle_world(resume_from) if _elastic else None
+        if _bundle_world is not None and _bundle_world != _world:
+            resume_state = load_checkpoint_resharded(resume_from)
+        elif _world > 1:
             resume_state = load_checkpoint(
                 resume_from, rank=jax.process_index(), world=_world)
         else:

@@ -103,6 +103,12 @@ class ObservabilityRegistry:
                            "staleness_slo_s": 0.0, "slo_alarm": 0,
                            "slo_breaches": 0, "torn_publishes": 0,
                            "quarantined_windows": 0}
+        # elastic membership (distributed/elastic.py): the epoch/world
+        # this rank currently believes, shrink/join commits observed,
+        # and the wall spent rebuilding shards after a resize
+        self._membership = {"epoch": 0, "world": 0, "resizes": 0,
+                            "shrinks": 0, "joins": 0,
+                            "reshard_wall_s": 0.0, "resharded_loads": 0}
         # shared singletons, NOT copies — existing call sites in
         # serving/, reliability/ and the phase timeits keep writing to
         # the same objects this registry reads.
@@ -179,6 +185,10 @@ class ObservabilityRegistry:
                                "staleness_slo_s": 0.0, "slo_alarm": 0,
                                "slo_breaches": 0, "torn_publishes": 0,
                                "quarantined_windows": 0}
+            self._membership = {"epoch": 0, "world": 0, "resizes": 0,
+                                "shrinks": 0, "joins": 0,
+                                "reshard_wall_s": 0.0,
+                                "resharded_loads": 0}
 
     # -- exporters ------------------------------------------------------
     def level_pipeline_snapshot(self) -> Dict:
@@ -249,6 +259,12 @@ class ObservabilityRegistry:
         f["max_data_to_serve_s"] = round(f["max_data_to_serve_s"], 6)
         return f
 
+    def membership_snapshot(self) -> Dict:
+        with self._lock:
+            m = dict(self._membership)
+        m["reshard_wall_s"] = round(m["reshard_wall_s"], 6)
+        return m
+
     def clock_skew_snapshot(self) -> Dict:
         with self._lock:
             s = dict(self._clock_skew)
@@ -270,6 +286,7 @@ class ObservabilityRegistry:
             "collective": self.collective_snapshot(),
             "distributed": self.distributed_snapshot(),
             "freshness": self.freshness_snapshot(),
+            "membership": self.membership_snapshot(),
             "flightrec": _flightrec.snapshot(),
             "profiler": _profiler.snapshot(),
             "hist_backend": self.hist_backend_snapshot(),
@@ -301,6 +318,7 @@ class ObservabilityRegistry:
             (snap["collective"], "lightgbm_tpu_collective", None),
             (snap["distributed"], "lightgbm_tpu_distributed", None),
             (snap["freshness"], "lightgbm_tpu_freshness", None),
+            (snap["membership"], "lightgbm_tpu_membership", None),
             (snap["clock_skew"], "lightgbm_tpu_clock_skew", None),
             (snap["flightrec"], "lightgbm_tpu_flightrec", None),
             (snap["hist_backend"], "lightgbm_tpu_hist_backend", None),
@@ -353,6 +371,38 @@ class ObservabilityRegistry:
     def record_collective_world(self, world: int) -> None:
         with self._lock:
             self._collective["world"] = int(world)
+
+    # -- elastic-membership hooks (distributed/elastic.py) --------------
+    # recorded even when disabled, like the watchdog hooks: a resize is
+    # an incident, and the metrics tail is the only record a
+    # reincarnated process has of the world it came from
+    def record_membership(self, epoch: int, world: int) -> None:
+        """This rank's current membership belief (set at distributed
+        init and again after every epoch adoption)."""
+        with self._lock:
+            self._membership["epoch"] = int(epoch)
+            self._membership["world"] = int(world)
+
+    def record_membership_resize(self, kind: str, epoch: int,
+                                 world: int, joined: int = 0) -> None:
+        """One committed membership change: `kind` is "shrink" or
+        "join"; `world`/`epoch` are the NEW values the record names."""
+        with self._lock:
+            m = self._membership
+            m["resizes"] += 1
+            if kind == "shrink":
+                m["shrinks"] += 1
+            m["joins"] += int(joined)
+            m["epoch"] = int(epoch)
+            m["world"] = int(world)
+
+    def record_membership_reshard(self, wall_s: float) -> None:
+        """One topology-flexible checkpoint load (W-rank bundle read by
+        a W'-rank world): the elasticity cost the bench sentinel
+        watches."""
+        with self._lock:
+            self._membership["resharded_loads"] += 1
+            self._membership["reshard_wall_s"] += float(wall_s)
 
     def record_clock_sample(self, site: str, walls) -> None:
         """One piggybacked clock-offset sample from a guarded collective

@@ -126,6 +126,23 @@ def validate_record(kind: str, name: str, rec) -> List[str]:
             if key in rec and not isinstance(rec[key], types):
                 problems.append(f"{name}: {key!r} has type "
                                 f"{type(rec[key]).__name__}")
+        # elasticity-cost block (rounds that exercised a mid-run
+        # resize): optional, but when present it is a typed object so
+        # the sentinel can trust its series
+        if "chaos_resize" in rec:
+            cr = rec["chaos_resize"]
+            if not isinstance(cr, dict):
+                problems.append(f"{name}: 'chaos_resize' is not an "
+                                f"object")
+            else:
+                for key, types in (
+                        ("resizes", int),
+                        ("reshard_wall_s", (int, float)),
+                        ("post_resize_trees_per_sec", (int, float))):
+                    if key in cr and not isinstance(cr[key], types):
+                        problems.append(
+                            f"{name}: chaos_resize[{key!r}] has type "
+                            f"{type(cr[key]).__name__}")
     else:
         problems.append(f"{name}: unknown record kind {kind!r}")
     return problems
@@ -171,6 +188,20 @@ def _multichip_points(records) -> Dict[str, List[Tuple[int, float]]]:
             if isinstance(v, (int, float)) and v > 0:
                 series.setdefault(f"multichip_{key}", []) \
                     .append((rnd, float(v)))
+        # elasticity cost (rounds that resized mid-run): post-resize
+        # throughput rides the drop detector like the main series;
+        # reshard wall is tracked inverted (1/wall) so a slower reshard
+        # registers as the drop it is
+        cr = rec.get("chaos_resize")
+        if isinstance(cr, dict) and cr.get("resizes", 0):
+            v = cr.get("post_resize_trees_per_sec")
+            if isinstance(v, (int, float)) and v > 0:
+                series.setdefault("multichip_post_resize_trees_per_sec",
+                                  []).append((rnd, float(v)))
+            w = cr.get("reshard_wall_s")
+            if isinstance(w, (int, float)) and w > 0:
+                series.setdefault("multichip_reshard_inv_wall", []) \
+                    .append((rnd, 1.0 / float(w)))
     return series
 
 

@@ -54,7 +54,11 @@ def guarded_allgather(x, label: str = "allgather") -> np.ndarray:
     pytree allgather (one extra float64 on the wire, zero extra
     collectives): the samples feed the cross-rank clock alignment of
     ``python -m lightgbm_tpu.observability merge`` and the
-    lightgbm_tpu_clock_skew metrics."""
+    lightgbm_tpu_clock_skew metrics. A membership epoch (one int64)
+    rides along the same way: a rank resumed from a stale membership
+    record would otherwise exchange rows sharded for the WRONG world —
+    every gather cross-checks epochs and raises on divergence
+    (distributed/elastic.py, stale-epoch rejection)."""
     import time
     from jax.experimental import multihost_utils
     from ..reliability.watchdog import collective_guard
@@ -64,10 +68,26 @@ def guarded_allgather(x, label: str = "allgather") -> np.ndarray:
         arr = np.ascontiguousarray(arr)   # changing the wire shape
 
     with collective_guard(label):
-        gathered, walls = multihost_utils.process_allgather(
-            (arr, np.float64(time.time())))
+        gathered, walls, epochs = multihost_utils.process_allgather(
+            (arr, np.float64(time.time()), np.int64(_local_epoch())))
     _record_clock_sample(label, walls)
+    _check_epochs(label, epochs)
     return np.asarray(gathered)
+
+
+def _local_epoch() -> int:
+    """This rank's membership epoch, stamped onto every gather."""
+    from ..distributed.elastic import current_epoch
+    return current_epoch()
+
+
+def _check_epochs(label: str, epochs) -> None:
+    """Stale-epoch rejection: every rank sees every rank's epoch on the
+    gather it just completed, so divergence raises on ALL ranks in the
+    same bracket (rank-uniform data -> rank-uniform control flow; no
+    COLL002 split-brain)."""
+    from ..distributed.elastic import check_epoch_agreement
+    check_epoch_agreement(np.asarray(epochs).reshape(-1), label)
 
 
 def _record_clock_sample(label: str, walls) -> None:

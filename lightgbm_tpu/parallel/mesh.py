@@ -224,3 +224,23 @@ def setup_multihost(num_machines: int, machines: str = "",
     coordinator = f"{entries[0][0]}:{entries[0][1]}"
     _enable_cpu_collectives()
     jax.distributed.initialize(coordinator, num_machines, rank)
+    _seed_membership_epoch(num_machines)
+
+
+def _seed_membership_epoch(world: int) -> None:
+    """Adopt the membership epoch a reincarnating supervisor handed us
+    (LIGHTGBM_TPU_EPOCH, written when an elastic shrink committed) so
+    the very first guarded collective of the new world already carries
+    the agreed epoch — a straggler resumed from the OLD membership
+    record diverges on that gather and is rejected instead of silently
+    exchanging rows sharded for the wrong world."""
+    epoch_env = os.environ.get("LIGHTGBM_TPU_EPOCH")
+    try:
+        from ..distributed.elastic import set_epoch
+        if epoch_env is not None:
+            set_epoch(int(epoch_env))
+        from ..observability.registry import registry
+        registry.record_membership(
+            int(epoch_env) if epoch_env is not None else 0, world)
+    except Exception:   # pragma: no cover - forensics must not block init
+        pass

@@ -5,30 +5,63 @@ attempt budget, sleeps inline). The continuous loop needs the same
 curve but OUTSIDE a single call: a cycle that crash-loops is retried
 across full recover/rebuild attempts, and the attempt counter lives in
 the driver, not in a wrapper frame. This policy object is that curve —
-deterministic (no jitter, same as retry.py, so chaos tests can assert
-exact delays) and injectable (`sleep=` stub for tests).
+deterministic by default (no jitter, same as retry.py, so chaos tests
+can assert exact delays) and injectable (`sleep=` stub for tests).
+
+Multi-rank retry ladders want the opposite of determinism: after an
+elastic resize every survivor retries against the SAME recovering peer
+on the SAME curve, so deterministic delays fire synchronized retry
+storms at exactly the moments the peer is busiest. ``jitter=
+"decorrelated"`` switches to the decorrelated-jitter curve (Brooker,
+AWS Architecture Blog 2015): each delay is drawn uniformly from
+[base, 3 * previous_delay], capped — successive ranks decorrelate
+after the first draw even if they crashed in lockstep. The RNG is a
+private seeded ``random.Random`` so tests (and reproducibility-minded
+supervisors) get a deterministic-yet-jittered sequence per seed.
 """
 
 from __future__ import annotations
 
+import random
 import time
-from typing import Callable
+from typing import Callable, Optional
 
 __all__ = ["BackoffPolicy"]
 
 
 class BackoffPolicy:
-    """delay(attempt) = min(base_ms * 2**attempt, max_ms), attempt 0-based."""
+    """delay(attempt) = min(base_ms * 2**attempt, max_ms), attempt 0-based.
+
+    With ``jitter="decorrelated"``:
+    delay = min(max_ms, uniform(base_ms, 3 * previous_delay)) — stateful
+    across calls (attempt number only floors the first draw), bounded by
+    [base_ms, max_ms] at every step.
+    """
 
     def __init__(self, base_ms: float = 50.0, max_ms: float = 2000.0,
-                 sleep: Callable[[float], None] = time.sleep):
+                 sleep: Callable[[float], None] = time.sleep,
+                 jitter: str = "none", seed: Optional[int] = None):
+        if jitter not in ("none", "decorrelated"):
+            raise ValueError(f"unknown jitter mode {jitter!r} "
+                             f"(expected 'none' or 'decorrelated')")
         self.base_ms = float(base_ms)
         self.max_ms = float(max_ms)
+        self.jitter = jitter
         self._sleep = sleep
+        self._rng = random.Random(seed)
+        self._prev_ms = self.base_ms
+
+    def reset(self) -> None:
+        """Forget jitter state (a recovered run restarts the ladder)."""
+        self._prev_ms = self.base_ms
 
     def delay_ms(self, attempt: int) -> float:
         if self.base_ms <= 0:
             return 0.0
+        if self.jitter == "decorrelated":
+            drawn = self._rng.uniform(self.base_ms, 3.0 * self._prev_ms)
+            self._prev_ms = min(max(drawn, self.base_ms), self.max_ms)
+            return self._prev_ms
         return min(self.base_ms * (2.0 ** max(0, int(attempt))),
                    self.max_ms)
 

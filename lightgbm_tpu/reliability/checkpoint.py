@@ -52,6 +52,7 @@ from .counters import counters
 from .faults import faults
 
 __all__ = ["CheckpointState", "save_checkpoint", "load_checkpoint",
+           "load_checkpoint_resharded", "bundle_world",
            "latest_checkpoint", "FORMAT_VERSION", "COMMIT_MARKER",
            "PIN_FILE", "pin_bundle", "pinned_bundle"]
 
@@ -356,6 +357,118 @@ def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
             best = it
     return os.path.join(ckpt_dir, _bundle_name(best)) if best is not None \
         else None
+
+
+def _resolve_bundle(path: str) -> str:
+    """`path` itself when it is a complete bundle, else the newest
+    complete bundle under it; raises when none exists."""
+    if _is_complete(path):
+        return path
+    found = latest_checkpoint(path)
+    if found is None:
+        raise LightGBMError(
+            f"no complete checkpoint bundle found under {path!r}")
+    return found
+
+
+def bundle_world(path: str) -> Optional[int]:
+    """world_size of the bundle that a resume from `path` would pick,
+    or None when no complete bundle exists — the topology probe the
+    elastic resume path uses to decide between the strict per-shard
+    loader and `load_checkpoint_resharded`."""
+    try:
+        bundle = _resolve_bundle(path)
+    except LightGBMError:
+        return None
+    state = _read_state(bundle)
+    if state is None:
+        return None
+    return int(state.get("world_size", 1))
+
+
+def load_checkpoint_resharded(path: str) -> CheckpointState:
+    """Topology-flexible load (distributed/elastic.py): read ALL of a
+    W-rank coordinated bundle's ``shard_<rank>.npz`` files and
+    concatenate the row-partitioned arrays in rank order into the
+    global arrays an uninterrupted single-partition run would hold.
+    Every rank of the new W'-rank world calls this, then slices its own
+    contiguous row block at restore time (`elastic.reshard_offsets` +
+    `elastic.reshard_slice` inside `GBDT.restore_training_state`).
+
+    The returned state carries ``resharded_from_world`` (the old W),
+    ``reshard_total_rows`` (global training rows) and
+    ``reshard_rows_per_rank`` — the restore path's slicing contract and
+    the test oracle for W -> W' -> W byte-identity. ``rng_key`` is
+    rank-replicated (every shard holds the same stream position), so
+    shard 0's copy is taken verbatim."""
+    import time as _time
+    t0 = _time.monotonic()
+    bundle = _resolve_bundle(path)
+    state = _read_state(bundle)
+    if state is None:
+        raise LightGBMError(f"checkpoint {bundle!r} lost its state.json "
+                            f"mid-load (concurrent prune?)")
+    ver = state.get("format_version")
+    if ver != FORMAT_VERSION:
+        raise LightGBMError(
+            f"checkpoint {bundle!r} has format_version={ver!r}; "
+            f"this build reads version {FORMAT_VERSION}")
+    ws = int(state.get("world_size", 1))
+    with open(os.path.join(bundle, "model.txt")) as f:
+        model_str = f.read()
+    shards: List[Dict[str, np.ndarray]] = []
+    if ws <= 1:
+        npz_path = os.path.join(bundle, "arrays.npz")
+        if os.path.isfile(npz_path):
+            with np.load(npz_path) as npz:
+                shards.append({k: npz[k] for k in npz.files})
+    else:
+        for r in range(ws):
+            npz_path = os.path.join(bundle, f"shard_{r:03d}.npz")
+            if not os.path.isfile(npz_path):
+                raise LightGBMError(
+                    f"resharded load: checkpoint {bundle!r} declares "
+                    f"world_size={ws} but shard_{r:03d}.npz is missing")
+            with np.load(npz_path) as npz:
+                shards.append({k: npz[k] for k in npz.files})
+    arrays: Dict[str, np.ndarray] = {}
+    rows_per_rank: List[int] = []
+    if shards:
+        keys = set(shards[0])
+        for r, shard in enumerate(shards):
+            if set(shard) != keys:
+                raise LightGBMError(
+                    f"resharded load: shard {r} of {bundle!r} carries "
+                    f"keys {sorted(shard)} but shard 0 has "
+                    f"{sorted(keys)} — bundle is torn")
+        rows_per_rank = [
+            int(np.asarray(s["train_score"]).shape[0]) if "train_score"
+            in s else 0 for s in shards]
+        for key in keys:
+            parts = [np.asarray(s[key]) for s in shards]
+            if key != "rng_key" and parts[0].ndim:
+                # row-partitioned state (train_score, bag_mask,
+                # valid_score_i): rank-order concatenation rebuilds the
+                # global row order the partitioner sliced
+                arrays[key] = np.concatenate(parts, axis=0) \
+                    if len(parts) > 1 else parts[0]
+            else:
+                # rank-replicated (rng_key) or scalar state
+                arrays[key] = parts[0]
+    out_state = dict(state)
+    out_state["resharded_from_world"] = ws
+    out_state["reshard_rows_per_rank"] = rows_per_rank
+    out_state["reshard_total_rows"] = int(sum(rows_per_rank))
+    counters.inc("checkpoint_resharded_loads")
+    recorder.record_checkpoint("checkpoint_reshard",
+                               int(state["iteration"]), bundle)
+    from ..observability.registry import registry
+    registry.record_membership_reshard(_time.monotonic() - t0)
+    Log.info(f"checkpoint: resharded load of {bundle} "
+             f"(world_size={ws}, rows={out_state['reshard_total_rows']})")
+    return CheckpointState(iteration=int(state["iteration"]),
+                           model_str=model_str, state=out_state,
+                           arrays=arrays, path=bundle)
 
 
 def load_checkpoint(path: str, rank: Optional[int] = None,

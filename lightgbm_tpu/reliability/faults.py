@@ -34,6 +34,9 @@ site                        raised from
 ``loop_publish``            continuous.ContinuousTrainer._publish, after the
                             serving swap but before the generation marker
                             advances (torn-publish window)
+``elastic_resize``          distributed.elastic.propose_shrink, before the
+                            shrink vote touches the heartbeat directory —
+                            a failed vote falls back to the watchdog abort
 ==========================  ==================================================
 
 All injection is host-side, at dispatch boundaries: raising inside
@@ -80,6 +83,7 @@ KNOWN_SITES = (
     "streaming_ingest",
     "distributed_hist_agg",
     "loop_publish",
+    "elastic_resize",
 )
 
 

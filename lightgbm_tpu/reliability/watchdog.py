@@ -134,7 +134,8 @@ class CollectiveGuard:
                  first_deadline_factor: float = FIRST_DEADLINE_FACTOR,
                  clock: Callable[[], float] = time.monotonic,
                  wall: Callable[[], float] = time.time,
-                 abort_fn: Optional[Callable[[str], None]] = None):
+                 abort_fn: Optional[Callable[[str], None]] = None,
+                 elastic: Optional[dict] = None):
         if timeout_s <= 0:
             raise ValueError("CollectiveGuard needs collective_timeout_s"
                              " > 0 (0 disables the watchdog)")
@@ -147,6 +148,10 @@ class CollectiveGuard:
         self._clock = clock
         self._wall = wall
         self._abort_fn = abort_fn
+        #: {"min_world": int, "epoch_timeout_s": float, "ckpt_dir": str}
+        #: when elastic_resize is on — the abort path then proposes a
+        #: shrink before giving up (distributed/elastic.py)
+        self.elastic = dict(elastic) if elastic else None
         self._lock = threading.Lock()
         self._site: Optional[str] = None
         self._deadline: Optional[float] = None
@@ -281,8 +286,71 @@ class CollectiveGuard:
         registry.record_collective_timeout()
         return self.diagnose(site)
 
+    def _try_elastic_resize(self, diag: str) -> bool:
+        """The elastic branch of the abort path: vote a shrink through
+        the heartbeat directory instead of dying. True means the resize
+        committed and this rank is gone (or, with a stubbed abort_fn,
+        the stub was told); False falls through to the plain abort —
+        a failed vote is never worse than today's behavior."""
+        ela = self.elastic
+        if ela is None or not self.heartbeat_dir:
+            return False
+        from ..distributed import elastic
+        exit_code = elastic.ELASTIC_RESIZE_EXIT_CODE
+        try:
+            rec = elastic.propose_shrink(
+                self.heartbeat_dir, rank=self.rank, world=self.world,
+                epoch=elastic.current_epoch(),
+                min_world=int(ela.get("min_world", 1)),
+                timeout_s=float(ela.get("epoch_timeout_s", 30.0)),
+                stale_after_s=3.0 * self.interval_s,
+                reason=diag[:300],
+                resume_bundle=self._elastic_resume_bundle(),
+                wall=self._wall)
+        except Exception as exc:
+            # includes InjectedFault at the elastic_resize site: the
+            # vote machinery must never mask the abort it replaces
+            Log.warning("elastic resize failed (%s: %s); falling back "
+                        "to watchdog abort", type(exc).__name__, exc)
+            return False
+        if rec is None:
+            return False
+        msg = (f"collective watchdog: {diag} — membership epoch "
+               f"{rec.epoch} committed (world {self.world} -> "
+               f"{rec.world}); exiting for reincarnation "
+               f"(os._exit({exit_code}))")
+        Log.warning(msg)
+        print(msg, file=sys.stderr, flush=True)
+        _flightrec.record("resize", "watchdog", diag=diag[:500],
+                          epoch=rec.epoch, world=rec.world,
+                          exit_code=exit_code)
+        if self._abort_fn is not None:
+            if _flightrec.out_dir:
+                _flightrec.flush("elastic_resize")
+            self._abort_fn(f"elastic_resize epoch={rec.epoch} "
+                           f"world={rec.world}: {diag}")
+            return True
+        _flightrec.flush("elastic_resize")
+        os._exit(exit_code)
+        return True     # unreachable; keeps the stubbed-exit tests honest
+
+    def _elastic_resume_bundle(self) -> str:
+        """The bundle the reincarnated world should resume from — the
+        newest committed checkpoint, named in the membership record so
+        the supervisor can snapshot it before relaunching."""
+        ckpt_dir = (self.elastic or {}).get("ckpt_dir", "")
+        if not ckpt_dir:
+            return ""
+        try:
+            from .checkpoint import latest_checkpoint
+            return latest_checkpoint(ckpt_dir) or ""
+        except Exception:       # forensics only; never block the vote
+            return ""
+
     def _abort(self, diag: str) -> None:
         from ..observability.registry import registry
+        if self._try_elastic_resize(diag):
+            return
         registry.record_collective_abort()
         _flightrec.record("abort", "watchdog", diag=diag[:500],
                           exit_code=WATCHDOG_EXIT_CODE)
@@ -366,12 +434,15 @@ def collective_guard(site: str):
 def configure_watchdog(timeout_s: float, rank: int = 0, world: int = 1,
                        heartbeat_dir: str = "",
                        interval_s: float = 1.0,
-                       abort_fn: Optional[Callable[[str], None]] = None
+                       abort_fn: Optional[Callable[[str], None]] = None,
+                       elastic: Optional[dict] = None
                        ) -> Optional[CollectiveGuard]:
     """Install (or tear down) the process-global guard. Disabled — and
     any previous guard stopped — when `timeout_s` <= 0 or `world` <= 1:
     the watchdog is strictly a multi-process affair. Idempotent for
-    unchanged settings, so every collective entry point may call it."""
+    unchanged settings, so every collective entry point may call it.
+    `elastic` ({"min_world", "epoch_timeout_s", "ckpt_dir"}) switches
+    the abort path to propose-shrink (distributed/elastic.py)."""
     global _guard
     with _guard_lock:
         if timeout_s <= 0 or world <= 1:
@@ -383,16 +454,29 @@ def configure_watchdog(timeout_s: float, rank: int = 0, world: int = 1,
         if (g is not None and g.timeout_s == float(timeout_s) and
                 g.rank == int(rank) and g.world == int(world) and
                 g.heartbeat_dir == heartbeat_dir and
-                g.interval_s == float(interval_s)):
+                g.interval_s == float(interval_s) and
+                g.elastic == (dict(elastic) if elastic else None)):
             return g
         if g is not None:
             g.stop()
+        if heartbeat_dir:
+            # restart hygiene: a reincarnated (or plainly restarted)
+            # world inherits the heartbeat dir of its predecessor —
+            # sweep heartbeats of ranks beyond the new world and shrink
+            # proposals consumed by committed epochs, so they cannot
+            # mis-age into "rank k last seen" culprits or confuse the
+            # next vote
+            from ..distributed.elastic import (current_epoch,
+                                               sweep_stale_epoch_files)
+            sweep_stale_epoch_files(heartbeat_dir, current_epoch(),
+                                    int(world))
         from ..observability.registry import registry
         registry.record_collective_world(int(world))
         _guard = CollectiveGuard(
             timeout_s, rank=rank, world=world,
             heartbeat_dir=heartbeat_dir,
-            heartbeat_interval_s=interval_s, abort_fn=abort_fn).start()
+            heartbeat_interval_s=interval_s, abort_fn=abort_fn,
+            elastic=elastic).start()
         return _guard
 
 
@@ -417,9 +501,16 @@ def maybe_start_watchdog(cfg) -> Optional[CollectiveGuard]:
     hb = cfg.heartbeat_dir
     if not hb and cfg.checkpoint_dir:
         hb = os.path.join(cfg.checkpoint_dir, "heartbeats")
+    elastic = None
+    if bool(getattr(cfg, "elastic_resize", False)):
+        elastic = {"min_world": int(getattr(cfg, "elastic_min_world", 1)),
+                   "epoch_timeout_s": float(
+                       getattr(cfg, "elastic_epoch_timeout_s", 30.0)),
+                   "ckpt_dir": cfg.checkpoint_dir or ""}
     return configure_watchdog(timeout_s, rank=jax.process_index(),
                               world=world, heartbeat_dir=hb,
-                              interval_s=cfg.heartbeat_interval_s)
+                              interval_s=cfg.heartbeat_interval_s,
+                              elastic=elastic)
 
 
 def shutdown_watchdog() -> None:

@@ -30,26 +30,30 @@ from typing import Dict, List, Optional
 
 from .subproc import RankResult, free_port, rank_env, run_ranks
 
-__all__ = ["CHAOS_WORKER", "run_chaos_training",
+__all__ = ["CHAOS_WORKER", "run_chaos_training", "run_elastic_training",
            "strip_rank_local_params"]
 
-#: worker source for one rank of a (possibly chaos-injected) 2-rank
-#: training run. Env contract — TEST_PORTS, TEST_OUT, TEST_ROUNDS,
+#: worker source for one rank of a (possibly chaos-injected) W-rank
+#: training run. Env contract — TEST_WORLD (default 2; 1 skips the
+#: multihost rendezvous entirely), TEST_PORTS, TEST_OUT, TEST_ROUNDS,
 #: TEST_CKPT_DIR/TEST_CKPT_PERIOD (checkpointing), TEST_TIMEOUT_S
 #: (collective watchdog; "0" disables), TEST_DEATH_RANK/TEST_DEATH_ITER
 #: (rank_death arming; death rank < 0 disables), TEST_RESUME ("1" to
-#: resume from TEST_CKPT_DIR).
+#: resume from TEST_CKPT_DIR), TEST_ELASTIC ("1" turns on
+#: elastic_resize — the watchdog votes a shrink instead of aborting).
 CHAOS_WORKER = textwrap.dedent("""
     import os, sys
     import numpy as np
     sys.path.insert(0, os.environ["TEST_REPO"])
     rank = int(os.environ["LIGHTGBM_TPU_MACHINE_RANK"])
+    world = int(os.environ.get("TEST_WORLD", "2"))
     ports = os.environ["TEST_PORTS"].split(",")
     import lightgbm_tpu as lgb
     from lightgbm_tpu.reliability import faults
-    lgb.setup_multihost(
-        2, ",".join(f"127.0.0.1:{p}" for p in ports),
-        local_listen_port=int(ports[rank]))
+    if world > 1:
+        lgb.setup_multihost(
+            world, ",".join(f"127.0.0.1:{p}" for p in ports),
+            local_listen_port=int(ports[rank]))
 
     def make_data(n=4096, f=8, seed=7):
         r = np.random.RandomState(seed)
@@ -60,13 +64,10 @@ CHAOS_WORKER = textwrap.dedent("""
         return X, y
 
     X, y = make_data()
-    cut = len(y) // 2
-    sl = slice(0, cut) if rank == 0 else slice(cut, None)
+    n = len(y)
+    sl = slice(rank * n // world, (rank + 1) * n // world)
     ckpt_dir = os.environ["TEST_CKPT_DIR"]
     params = dict(objective="binary", tree_learner="data",
-                  num_machines=2,
-                  machines=",".join(f"127.0.0.1:{p}" for p in ports),
-                  local_listen_port=int(ports[rank]),
                   num_leaves=15, verbosity=-1, min_data_in_leaf=20,
                   enable_bundle=False, boost_from_average=False,
                   checkpoint_period=int(os.environ["TEST_CKPT_PERIOD"]),
@@ -74,6 +75,14 @@ CHAOS_WORKER = textwrap.dedent("""
                   collective_timeout_s=float(os.environ["TEST_TIMEOUT_S"]),
                   heartbeat_interval_s=0.25,
                   heartbeat_dir=os.path.join(ckpt_dir, "heartbeats"))
+    if world > 1:
+        params.update(
+            num_machines=world,
+            machines=",".join(f"127.0.0.1:{p}" for p in ports),
+            local_listen_port=int(ports[rank]))
+    if os.environ.get("TEST_ELASTIC", "0") == "1":
+        params.update(elastic_resize=True, elastic_min_world=1,
+                      elastic_epoch_timeout_s=20.0)
 
     death_rank = int(os.environ.get("TEST_DEATH_RANK", "-1"))
     death_iter = int(os.environ.get("TEST_DEATH_ITER", "-1"))
@@ -109,27 +118,34 @@ def run_chaos_training(workdir: str, *, rounds: int,
                        death_iter: int = -1, resume: bool = False,
                        harness_timeout: float = 420.0,
                        out_prefix: str = "model",
-                       devices_per_rank: int = 4) -> List[RankResult]:
-    """Launch the 2-rank chaos worker; returns per-rank results. Model
-    files land at ``<workdir>/<out_prefix>_<rank>.txt``.
-    `devices_per_rank` sets each rank's virtual host-device count —
-    the default 2x4 geometry is the 8-device global mesh the
-    distributed acceptance scenario kills a rank out of."""
+                       devices_per_rank: int = 4,
+                       world: int = 2, elastic: bool = False,
+                       extra_env: Optional[Dict[str, str]] = None
+                       ) -> List[RankResult]:
+    """Launch the W-rank chaos worker (default: the 2-rank scenario);
+    returns per-rank results. Model files land at
+    ``<workdir>/<out_prefix>_<rank>.txt``. `devices_per_rank` sets each
+    rank's virtual host-device count — the default 2x4 geometry is the
+    8-device global mesh the distributed acceptance scenario kills a
+    rank out of. `world=1` runs a single process with no multihost
+    rendezvous (the shape an elastic shrink reincarnates into);
+    `elastic=True` arms elastic_resize in the worker's params."""
     from .subproc import repo_root
     os.makedirs(workdir, exist_ok=True)
     worker_py = os.path.join(workdir, "chaos_worker.py")
     with open(worker_py, "w") as f:
         f.write(CHAOS_WORKER)
-    ports = [str(free_port()), str(free_port())]
+    ports = [str(free_port()) for _ in range(world)]
     envs: List[Dict[str, str]] = []
     import sys
     argvs = []
-    for rank in range(2):
+    for rank in range(world):
         envs.append(rank_env(
             rank,
             XLA_FLAGS="--xla_force_host_platform_device_count=%d"
                       % devices_per_rank,
             TEST_REPO=repo_root(),
+            TEST_WORLD=world,
             TEST_PORTS=",".join(ports),
             TEST_OUT=os.path.join(workdir, f"{out_prefix}_{rank}.txt"),
             TEST_ROUNDS=rounds,
@@ -138,10 +154,96 @@ def run_chaos_training(workdir: str, *, rounds: int,
             TEST_TIMEOUT_S=timeout_s,
             TEST_DEATH_RANK=death_rank,
             TEST_DEATH_ITER=death_iter,
-            TEST_RESUME="1" if resume else "0"))
+            TEST_RESUME="1" if resume else "0",
+            TEST_ELASTIC="1" if elastic else "0",
+            **(extra_env or {})))
         argvs.append([sys.executable, worker_py])
     return run_ranks(argvs, envs=envs, cwd=workdir,
                      timeout=harness_timeout)
+
+
+def run_elastic_training(workdir: str, *, rounds: int,
+                         ckpt_period: int, ckpt_dir: str,
+                         timeout_s: float, death_rank: int,
+                         death_iter: int, world: int = 2,
+                         harness_timeout: float = 420.0,
+                         devices_per_rank: int = 4,
+                         max_relaunches: int = 3) -> Dict:
+    """The shrink-and-finish supervisor (docs/Distributed.md
+    "Elasticity"): launch a W-rank elastic run with a scheduled rank
+    death; when survivors exit with ELASTIC_RESIZE_EXIT_CODE (75) after
+    committing a membership record, snapshot the epoch's resume bundle
+    (so a fixed-world parity run can resume from the IDENTICAL state)
+    and relaunch them at the shrunken world with the committed epoch in
+    LIGHTGBM_TPU_EPOCH — repeating until every rank exits 0. Any
+    watchdog abort (113) or missing membership record fails the run:
+    "zero aborts" is the acceptance bar, not best-effort.
+
+    Returns {"history": [per-generation RankResult lists], "record":
+    final MembershipRecord, "snapshot_dir": copied bundle dir or None,
+    "out_prefix": prefix of the finishing generation's model files,
+    "final_world": world of the finishing generation}."""
+    import shutil
+    from ..distributed.elastic import (ELASTIC_RESIZE_EXIT_CODE,
+                                       load_membership)
+    from ..reliability.watchdog import WATCHDOG_EXIT_CODE
+    hb_dir = os.path.join(ckpt_dir, "heartbeats")
+    out_prefix = "elastic_g0"
+    results = run_chaos_training(
+        workdir, rounds=rounds, ckpt_period=ckpt_period,
+        ckpt_dir=ckpt_dir, timeout_s=timeout_s, death_rank=death_rank,
+        death_iter=death_iter, world=world, elastic=True,
+        harness_timeout=harness_timeout, out_prefix=out_prefix,
+        devices_per_rank=devices_per_rank)
+    history = [results]
+    snapshot_dir: Optional[str] = None
+    record = None
+    epoch = 0
+    cur_world = world
+    relaunches = 0
+    while any(r.returncode != 0 for r in results):
+        rcs = [r.returncode for r in results]
+        if WATCHDOG_EXIT_CODE in rcs:
+            raise AssertionError(
+                f"elastic run aborted instead of resizing: rcs={rcs}")
+        if ELASTIC_RESIZE_EXIT_CODE not in rcs:
+            raise AssertionError(
+                f"no resize exit among failing ranks: rcs={rcs}")
+        if relaunches >= max_relaunches:
+            raise AssertionError(
+                f"relaunch budget ({max_relaunches}) exhausted at "
+                f"world={cur_world}")
+        rec = load_membership(hb_dir)
+        if rec is None or rec.epoch <= epoch:
+            raise AssertionError(
+                "resize exit without a newer membership record "
+                f"(have epoch {epoch}, dir {hb_dir})")
+        epoch, record, cur_world = rec.epoch, rec, rec.world
+        if rec.resume_bundle and snapshot_dir is None:
+            # copy BEFORE relaunching: the reincarnated run writes new
+            # bundles into ckpt_dir, and the parity contract needs the
+            # exact bundle this epoch resumed from
+            snapshot_dir = os.path.join(
+                workdir, f"snapshot_epoch_{rec.epoch}")
+            os.makedirs(snapshot_dir, exist_ok=True)
+            shutil.copytree(
+                rec.resume_bundle,
+                os.path.join(snapshot_dir,
+                             os.path.basename(rec.resume_bundle)))
+        relaunches += 1
+        out_prefix = f"elastic_g{relaunches}"
+        results = run_chaos_training(
+            workdir, rounds=rounds, ckpt_period=ckpt_period,
+            ckpt_dir=ckpt_dir, timeout_s=timeout_s,
+            death_rank=-1, death_iter=-1, world=cur_world,
+            elastic=True, resume=True,
+            harness_timeout=harness_timeout, out_prefix=out_prefix,
+            devices_per_rank=devices_per_rank,
+            extra_env={"LIGHTGBM_TPU_EPOCH": str(epoch)})
+        history.append(results)
+    return {"history": history, "record": record,
+            "snapshot_dir": snapshot_dir, "out_prefix": out_prefix,
+            "final_world": cur_world}
 
 
 def strip_rank_local_params(model_text: str) -> str:

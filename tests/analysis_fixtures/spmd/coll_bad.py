@@ -48,3 +48,13 @@ def ragged_gather(rows):
     n = len(rows)
     head = rows[:n]
     return multihost_utils.process_allgather(head)
+
+
+def resize_epoch_vote(flag):
+    # elastic-resize anti-pattern: only the coordinator gathers the
+    # shrink vote while survivors skip the collective — the exact
+    # deadlock the heartbeat-directory vote protocol exists to avoid
+    r = jax.process_index()
+    if r == 0:
+        return multihost_utils.process_allgather(flag)
+    return np.asarray(flag)

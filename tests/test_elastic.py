@@ -1,0 +1,617 @@
+"""Elastic world-resize tests (docs/Distributed.md "Elasticity").
+
+Fast tier (no subprocesses, tier-1): the membership-epoch state
+machine, stale-epoch rejection, the reshard loader's W -> W' -> W
+byte-identity, the heartbeat-directory shrink vote, the watchdog's
+propose-shrink-then-fall-back abort path, decorrelated backoff jitter,
+the lightgbm_tpu_membership registry family and the regression
+sentinel's chaos_resize block.
+
+Slow tier (`make elastic`): the shrink-and-finish reincarnation
+scenario — a rank killed mid-iteration at the 2-rank x 4-device
+geometry, survivors vote a new epoch and exit 75 (never 113), the
+supervisor relaunches them at the shrunken world, and the finished
+model is byte-identical to a fixed-world run resumed from the same
+epoch checkpoint.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.distributed import elastic
+from lightgbm_tpu.observability.registry import registry
+from lightgbm_tpu.reliability.backoff import BackoffPolicy
+from lightgbm_tpu.reliability.checkpoint import (
+    COMMIT_MARKER, FORMAT_VERSION, bundle_world, load_checkpoint_resharded)
+from lightgbm_tpu.reliability.faults import (KNOWN_SITES,
+                                             InjectedFault, faults)
+from lightgbm_tpu.reliability.watchdog import (CollectiveGuard,
+                                               write_heartbeat)
+from lightgbm_tpu.utils.log import LightGBMError
+
+pytestmark = pytest.mark.elastic
+
+
+@pytest.fixture(autouse=True)
+def _fresh_epoch(monkeypatch):
+    monkeypatch.delenv("LIGHTGBM_TPU_EPOCH", raising=False)
+    elastic.reset_epoch()
+    yield
+    elastic.reset_epoch()
+
+
+# ----------------------------------------------------------------------
+# membership-epoch state + stale-epoch rejection
+
+def test_epoch_defaults_to_zero_and_is_settable():
+    assert elastic.current_epoch() == 0
+    elastic.set_epoch(3)
+    assert elastic.current_epoch() == 3
+
+
+def test_epoch_seeded_from_supervisor_env(monkeypatch):
+    monkeypatch.setenv("LIGHTGBM_TPU_EPOCH", "7")
+    elastic.reset_epoch()
+    assert elastic.current_epoch() == 7
+
+
+def test_epoch_agreement_accepts_uniform_epochs():
+    elastic.set_epoch(2)
+    elastic.check_epoch_agreement([2, 2, 2], "unit")
+
+
+def test_epoch_agreement_rejects_span():
+    elastic.set_epoch(2)
+    with pytest.raises(LightGBMError, match="span membership epochs"):
+        elastic.check_epoch_agreement([1, 2], "unit")
+
+
+def test_epoch_agreement_rejects_foreign_epoch():
+    elastic.set_epoch(2)
+    with pytest.raises(LightGBMError, match="does not match"):
+        elastic.check_epoch_agreement([1, 1], "unit")
+
+
+def test_epoch_agree_single_process():
+    elastic.set_epoch(5)
+    assert elastic.epoch_agree() == 5
+
+
+def test_guarded_allgather_carries_epoch_single_process():
+    # the piggybacked epoch round-trips the wire and agrees with the
+    # local epoch — the rank-uniform fast path of stale-epoch rejection
+    from lightgbm_tpu.parallel.comm import guarded_allgather
+    elastic.set_epoch(4)
+    out = guarded_allgather(np.arange(3), label="elastic_unit")
+    np.testing.assert_array_equal(np.asarray(out).reshape(-1),
+                                  [0, 1, 2])
+
+
+# ----------------------------------------------------------------------
+# reshard: offsets, slicing, and the topology-flexible loader
+
+def test_reshard_offsets_single_process():
+    assert elastic.reshard_offsets(17) == (0, 17)
+
+
+def test_reshard_slice_partitions_rows_and_keeps_rng_key():
+    rng_key = np.asarray([7, 9], dtype=np.uint32)
+    arrays = {"train_score": np.arange(10, dtype=np.float32),
+              "bag_mask": np.arange(10) % 2 == 0,
+              "rng_key": rng_key}
+    lo = elastic.reshard_slice(arrays, 0, 6, 10)
+    hi = elastic.reshard_slice(arrays, 6, 4, 10)
+    np.testing.assert_array_equal(lo["train_score"], np.arange(6))
+    np.testing.assert_array_equal(hi["train_score"], np.arange(6, 10))
+    assert lo["train_score"].shape[0] + hi["train_score"].shape[0] == 10
+    np.testing.assert_array_equal(lo["rng_key"], rng_key)
+    np.testing.assert_array_equal(hi["rng_key"], rng_key)
+
+
+def _write_world2_bundle(ckpt_dir, iteration=4, rows=(6, 4)):
+    """A committed 2-rank coordinated bundle with row-partitioned
+    arrays; returns (bundle_path, per-rank array dicts)."""
+    bundle = os.path.join(ckpt_dir, f"ckpt_{iteration:07d}")
+    os.makedirs(bundle, exist_ok=True)
+    with open(os.path.join(bundle, "model.txt"), "w") as f:
+        f.write("tree\nend of trees\n")
+    with open(os.path.join(bundle, "state.json"), "w") as f:
+        json.dump({"format_version": FORMAT_VERSION,
+                   "iteration": iteration, "world_size": 2}, f)
+    shards = []
+    offset = 0
+    rng_key = np.asarray([11, 13], dtype=np.uint32)
+    for r, n in enumerate(rows):
+        arrs = {"train_score": np.arange(offset, offset + n,
+                                         dtype=np.float32),
+                "bag_mask": (np.arange(offset, offset + n) % 3 == 0),
+                "rng_key": rng_key}
+        np.savez(os.path.join(bundle, f"shard_{r:03d}.npz"), **arrs)
+        shards.append(arrs)
+        offset += n
+    with open(os.path.join(bundle, COMMIT_MARKER), "w") as f:
+        f.write("ok\n")
+    return bundle, shards
+
+
+def test_bundle_world_probe(tmp_path):
+    assert bundle_world(str(tmp_path / "nope")) is None
+    ckpt_dir = str(tmp_path / "ck")
+    _write_world2_bundle(ckpt_dir)
+    assert bundle_world(ckpt_dir) == 2
+
+
+def test_reshard_loader_roundtrip_is_byte_identical(tmp_path):
+    # W=2 bundle -> W'=1 global load -> sliced back into W=2 blocks:
+    # every byte of the original shards must come back
+    ckpt_dir = str(tmp_path / "ck")
+    bundle, shards = _write_world2_bundle(ckpt_dir, rows=(6, 4))
+    st = load_checkpoint_resharded(ckpt_dir)
+    assert st.iteration == 4
+    assert st.state["resharded_from_world"] == 2
+    assert st.state["reshard_total_rows"] == 10
+    assert st.state["reshard_rows_per_rank"] == [6, 4]
+    np.testing.assert_array_equal(st.arrays["train_score"],
+                                  np.arange(10, dtype=np.float32))
+    np.testing.assert_array_equal(st.arrays["rng_key"],
+                                  shards[0]["rng_key"])
+    offset = 0
+    for r, orig in enumerate(shards):
+        n = orig["train_score"].shape[0]
+        back = elastic.reshard_slice(st.arrays, offset, n, 10)
+        for key in orig:
+            assert back[key].tobytes() == orig[key].tobytes(), \
+                f"shard {r} key {key} not byte-identical"
+        offset += n
+
+
+def test_reshard_loader_rejects_missing_shard(tmp_path):
+    ckpt_dir = str(tmp_path / "ck")
+    bundle, _ = _write_world2_bundle(ckpt_dir)
+    os.unlink(os.path.join(bundle, "shard_001.npz"))
+    # a missing shard also un-commits the bundle for latest_checkpoint?
+    # no — COMMIT is still present; the loader must name the tear
+    with pytest.raises(LightGBMError, match="shard_001"):
+        load_checkpoint_resharded(ckpt_dir)
+
+
+def test_reshard_loader_counts_in_membership_metrics(tmp_path):
+    registry.reset()
+    ckpt_dir = str(tmp_path / "ck")
+    _write_world2_bundle(ckpt_dir)
+    load_checkpoint_resharded(ckpt_dir)
+    snap = registry.membership_snapshot()
+    assert snap["resharded_loads"] == 1
+    assert snap["reshard_wall_s"] >= 0.0
+
+
+# ----------------------------------------------------------------------
+# the heartbeat-directory shrink vote
+
+def _stamp(hb_dir, rank, when):
+    write_heartbeat(hb_dir, rank, when)
+
+
+def test_plan_resize_names_survivors_dead_and_joiners(tmp_path):
+    hb = str(tmp_path / "hb")
+    now = 1000.0
+    _stamp(hb, 0, now - 0.1)        # fresh (self anyway)
+    _stamp(hb, 1, now - 60.0)       # stale -> dead
+    # rank 2 never heartbeat -> dead
+    elastic.request_join(hb, "replacement-a", now=now)
+    survivors, dead, joiners = elastic.plan_resize(
+        hb, rank=0, world=3, stale_after_s=3.0, now=now)
+    assert survivors == [0]
+    assert dead == [1, 2]
+    assert joiners == ["replacement-a"]
+
+
+def test_propose_shrink_single_survivor_commits(tmp_path):
+    registry.reset()
+    hb = str(tmp_path / "hb")
+    now = 1000.0
+    _stamp(hb, 0, now)
+    _stamp(hb, 1, now - 60.0)
+    rec = elastic.propose_shrink(
+        hb, rank=0, world=2, epoch=0, min_world=1, timeout_s=5.0,
+        stale_after_s=3.0, reason="unit", resume_bundle="/b",
+        wall=lambda: now, sleep=lambda s: None)
+    assert rec is not None
+    assert (rec.epoch, rec.world, rec.members) == (1, 1, (0,))
+    assert rec.resume_bundle == "/b"
+    # committed record is durable and re-readable
+    back = elastic.load_membership(hb)
+    assert back == rec
+    assert back.new_rank(0) == 0 and back.new_rank(1) is None
+    snap = registry.membership_snapshot()
+    assert snap["resizes"] == 1 and snap["shrinks"] == 1
+    assert (snap["epoch"], snap["world"]) == (1, 1)
+
+
+def test_propose_shrink_two_survivors_agree(tmp_path):
+    hb = str(tmp_path / "hb")
+    now = 1000.0
+    _stamp(hb, 0, now)
+    _stamp(hb, 1, now)
+    _stamp(hb, 2, now - 60.0)       # the dead one
+    # peer rank 1's agreeing proposal is already on disk
+    elastic._write_json_atomic(
+        elastic._proposal_path(hb, 1, 1),
+        {"epoch": 1, "from_rank": 1, "old_world": 3,
+         "members": [0, 1], "joiners": [], "stamp": now})
+    rec = elastic.propose_shrink(
+        hb, rank=0, world=3, epoch=0, timeout_s=5.0, stale_after_s=3.0,
+        wall=lambda: now, sleep=lambda s: None)
+    assert rec is not None
+    assert (rec.world, rec.members) == (2, (0, 1))
+    # rank 1 (not the committer) verifies the same record
+    rec1 = elastic.propose_shrink(
+        hb, rank=1, world=3, epoch=0, timeout_s=5.0, stale_after_s=3.0,
+        wall=lambda: now, sleep=lambda s: None)
+    assert rec1 == rec
+
+
+def test_propose_shrink_admits_parked_joiner(tmp_path):
+    hb = str(tmp_path / "hb")
+    now = 1000.0
+    _stamp(hb, 0, now)
+    _stamp(hb, 1, now - 60.0)
+    elastic.request_join(hb, "newbie", now=now)
+    rec = elastic.propose_shrink(
+        hb, rank=0, world=2, epoch=0, timeout_s=5.0, stale_after_s=3.0,
+        wall=lambda: now, sleep=lambda s: None)
+    assert rec is not None
+    assert rec.world == 2           # 1 survivor + 1 joiner
+    assert rec.joiners == ("newbie",)
+
+
+def test_propose_shrink_refuses_when_nobody_died(tmp_path):
+    hb = str(tmp_path / "hb")
+    now = 1000.0
+    _stamp(hb, 0, now)
+    _stamp(hb, 1, now - 0.5)        # fresh: wedged, not dead
+    assert elastic.propose_shrink(
+        hb, rank=0, world=2, epoch=0, timeout_s=5.0, stale_after_s=3.0,
+        wall=lambda: now, sleep=lambda s: None) is None
+
+
+def test_propose_shrink_respects_min_world(tmp_path):
+    hb = str(tmp_path / "hb")
+    now = 1000.0
+    _stamp(hb, 0, now)
+    _stamp(hb, 1, now - 60.0)
+    assert elastic.propose_shrink(
+        hb, rank=0, world=2, epoch=0, min_world=2, timeout_s=5.0,
+        stale_after_s=3.0, wall=lambda: now,
+        sleep=lambda s: None) is None
+
+
+def test_propose_shrink_aborts_on_disagreement(tmp_path):
+    hb = str(tmp_path / "hb")
+    now = 1000.0
+    _stamp(hb, 0, now)
+    _stamp(hb, 1, now)
+    _stamp(hb, 2, now - 60.0)
+    elastic._write_json_atomic(
+        elastic._proposal_path(hb, 1, 1),
+        {"epoch": 1, "from_rank": 1, "old_world": 3,
+         "members": [1], "joiners": [], "stamp": now})   # disagrees
+    assert elastic.propose_shrink(
+        hb, rank=0, world=3, epoch=0, timeout_s=5.0, stale_after_s=3.0,
+        wall=lambda: now, sleep=lambda s: None) is None
+
+
+def test_propose_shrink_times_out_waiting_for_peer(tmp_path):
+    hb = str(tmp_path / "hb")
+    start = 1000.0
+    _stamp(hb, 0, start)
+    _stamp(hb, 1, start)
+    _stamp(hb, 2, start - 60.0)
+    clock = {"t": start}
+
+    def wall():
+        return clock["t"]
+
+    def sleep(s):
+        clock["t"] += 1.0           # advance past the deadline quickly
+
+    assert elastic.propose_shrink(
+        hb, rank=0, world=3, epoch=0, timeout_s=2.0, stale_after_s=3.0,
+        wall=wall, sleep=sleep) is None
+
+
+def test_propose_shrink_carries_fault_site(tmp_path):
+    hb = str(tmp_path / "hb")
+    _stamp(hb, 0, 1000.0)
+    _stamp(hb, 1, 940.0)
+    assert "elastic_resize" in KNOWN_SITES
+    faults.schedule("elastic_resize", fail=1)
+    try:
+        with pytest.raises(InjectedFault):
+            elastic.propose_shrink(
+                hb, rank=0, world=2, epoch=0, timeout_s=5.0,
+                stale_after_s=3.0, wall=lambda: 1000.0,
+                sleep=lambda s: None)
+    finally:
+        faults.clear()
+
+
+# ----------------------------------------------------------------------
+# epoch-file hygiene
+
+def test_sweep_stale_epoch_files(tmp_path):
+    hb = str(tmp_path / "hb")
+    now = 1000.0
+    _stamp(hb, 0, now)
+    _stamp(hb, 3, now)                     # rank beyond the new world
+    elastic._write_json_atomic(            # consumed proposal
+        elastic._proposal_path(hb, 1, 0),
+        {"epoch": 1, "members": [0]})
+    elastic._write_json_atomic(            # future proposal survives
+        elastic._proposal_path(hb, 2, 0),
+        {"epoch": 2, "members": [0]})
+    elastic._write_json_atomic(            # committed history survives
+        elastic._member_path(hb, 1),
+        {"epoch": 1, "world": 1, "members": [0]})
+    elastic.sweep_stale_epoch_files(hb, epoch=1, world=2)
+    names = sorted(os.listdir(hb))
+    assert "hb_rank_000" in names
+    assert "hb_rank_003" not in names
+    assert os.path.basename(elastic._proposal_path(hb, 1, 0)) \
+        not in names
+    assert os.path.basename(elastic._proposal_path(hb, 2, 0)) in names
+    assert os.path.basename(elastic._member_path(hb, 1)) in names
+
+
+def test_configure_watchdog_sweeps_on_rearm(tmp_path):
+    from lightgbm_tpu.reliability.watchdog import (configure_watchdog,
+                                                   shutdown_watchdog)
+    hb = str(tmp_path / "hb")
+    _stamp(hb, 0, 1000.0)
+    _stamp(hb, 5, 1000.0)                  # ghost of the bigger world
+    try:
+        configure_watchdog(5.0, rank=0, world=2, heartbeat_dir=hb,
+                           interval_s=0.25, abort_fn=lambda d: None)
+        assert not os.path.exists(os.path.join(hb, "hb_rank_005"))
+    finally:
+        shutdown_watchdog()
+
+
+# ----------------------------------------------------------------------
+# the watchdog abort path: propose-shrink, fall back to abort
+
+def _make_guard(hb, *, elastic_cfg, aborts, now=1000.0):
+    return CollectiveGuard(
+        5.0, rank=0, world=2, heartbeat_dir=hb,
+        heartbeat_interval_s=0.25, wall=lambda: now,
+        abort_fn=aborts.append, elastic=elastic_cfg)
+
+
+def test_watchdog_abort_becomes_resize_when_elastic(tmp_path):
+    registry.reset()
+    hb = str(tmp_path / "hb")
+    now = 1000.0
+    _stamp(hb, 0, now)
+    _stamp(hb, 1, now - 60.0)
+    aborts = []
+    g = _make_guard(hb, elastic_cfg={"min_world": 1,
+                                     "epoch_timeout_s": 5.0,
+                                     "ckpt_dir": ""},
+                    aborts=aborts, now=now)
+    g._abort("rank 1 last seen 60.0s ago")
+    assert len(aborts) == 1
+    assert aborts[0].startswith("elastic_resize epoch=1 world=1")
+    assert elastic.load_membership(hb).world == 1
+    # the resize path must NOT count as a watchdog abort
+    assert registry.collective_snapshot()["aborts"] == 0
+
+
+def test_watchdog_abort_unchanged_when_elastic_off(tmp_path):
+    registry.reset()
+    hb = str(tmp_path / "hb")
+    now = 1000.0
+    _stamp(hb, 0, now)
+    _stamp(hb, 1, now - 60.0)
+    aborts = []
+    g = _make_guard(hb, elastic_cfg=None, aborts=aborts, now=now)
+    g._abort("rank 1 last seen 60.0s ago")
+    assert aborts == ["rank 1 last seen 60.0s ago"]
+    assert elastic.load_membership(hb) is None        # no vote ran
+    assert registry.collective_snapshot()["aborts"] == 1
+
+
+def test_watchdog_falls_back_to_abort_when_vote_fails(tmp_path):
+    # all peers fresh -> propose_shrink returns None -> plain abort
+    registry.reset()
+    hb = str(tmp_path / "hb")
+    now = 1000.0
+    _stamp(hb, 0, now)
+    _stamp(hb, 1, now - 0.1)
+    aborts = []
+    g = _make_guard(hb, elastic_cfg={"min_world": 1,
+                                     "epoch_timeout_s": 5.0,
+                                     "ckpt_dir": ""},
+                    aborts=aborts, now=now)
+    g._abort("wedged interconnect")
+    assert aborts == ["wedged interconnect"]
+    assert registry.collective_snapshot()["aborts"] == 1
+
+
+def test_watchdog_falls_back_when_resize_site_injected(tmp_path):
+    registry.reset()
+    hb = str(tmp_path / "hb")
+    now = 1000.0
+    _stamp(hb, 0, now)
+    _stamp(hb, 1, now - 60.0)
+    aborts = []
+    g = _make_guard(hb, elastic_cfg={"min_world": 1,
+                                     "epoch_timeout_s": 5.0,
+                                     "ckpt_dir": ""},
+                    aborts=aborts, now=now)
+    faults.schedule("elastic_resize", fail=1)
+    try:
+        g._abort("rank 1 last seen 60.0s ago")
+    finally:
+        faults.clear()
+    assert aborts == ["rank 1 last seen 60.0s ago"]   # plain abort
+    assert registry.collective_snapshot()["aborts"] == 1
+
+
+# ----------------------------------------------------------------------
+# observability: the lightgbm_tpu_membership family
+
+def test_membership_registry_family():
+    registry.reset()
+    registry.record_membership(2, 3)
+    registry.record_membership_resize("shrink", 3, 2, joined=1)
+    registry.record_membership_reshard(0.25)
+    snap = registry.membership_snapshot()
+    assert snap == {"epoch": 3, "world": 2, "resizes": 1, "shrinks": 1,
+                    "joins": 1, "reshard_wall_s": 0.25,
+                    "resharded_loads": 1}
+    text = registry.prometheus_text()
+    assert "lightgbm_tpu_membership_epoch 3" in text
+    assert "lightgbm_tpu_membership_world 2" in text
+    registry.reset()
+    assert registry.membership_snapshot()["resizes"] == 0
+
+
+# ----------------------------------------------------------------------
+# satellite: decorrelated backoff jitter
+
+def test_backoff_default_curve_is_unchanged():
+    p = BackoffPolicy(base_ms=50.0, max_ms=2000.0)
+    assert [p.delay_ms(a) for a in range(7)] == \
+        [50.0, 100.0, 200.0, 400.0, 800.0, 1600.0, 2000.0]
+
+
+def test_backoff_decorrelated_jitter_bounds_and_determinism():
+    kw = dict(base_ms=50.0, max_ms=2000.0, jitter="decorrelated",
+              seed=42)
+    a = BackoffPolicy(**kw)
+    b = BackoffPolicy(**kw)
+    seq_a = [a.delay_ms(i) for i in range(64)]
+    seq_b = [b.delay_ms(i) for i in range(64)]
+    assert seq_a == seq_b                        # seeded: deterministic
+    prev = 50.0
+    for d in seq_a:
+        # curve bounds: base <= d <= min(max, 3*prev)
+        assert 50.0 <= d <= 2000.0
+        assert d <= max(50.0, 3.0 * prev) + 1e-9
+        prev = d
+    assert len(set(seq_a)) > 8                   # actually jittered
+    # different seeds decorrelate (the point of the exercise)
+    c = BackoffPolicy(base_ms=50.0, max_ms=2000.0,
+                      jitter="decorrelated", seed=43)
+    assert [c.delay_ms(i) for i in range(64)] != seq_a
+    # reset() restarts the ladder reproducibly-shaped
+    a.reset()
+    assert a.delay_ms(0) >= 50.0
+
+
+def test_backoff_rejects_unknown_jitter():
+    with pytest.raises(ValueError, match="jitter"):
+        BackoffPolicy(jitter="full")
+
+
+def test_backoff_wait_sleeps_jittered_delay():
+    slept = []
+    p = BackoffPolicy(base_ms=50.0, max_ms=2000.0, sleep=slept.append,
+                      jitter="decorrelated", seed=7)
+    d = p.wait(0)
+    assert slept == [d / 1e3]
+
+
+# ----------------------------------------------------------------------
+# satellite: the regression sentinel's chaos_resize block
+
+def test_regress_validates_chaos_resize_block():
+    from lightgbm_tpu.observability.regress import validate_record
+    rec = {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+           "trees_per_sec": 10.0, "vs_baseline": 1.0,
+           "tree_learner": "data",
+           "chaos_resize": {"resizes": 1, "reshard_wall_s": 0.5,
+                            "post_resize_trees_per_sec": 9.0}}
+    assert validate_record("multichip", "MULTICHIP_r07.json", rec) == []
+    bad = dict(rec, chaos_resize={"resizes": "one"})
+    problems = validate_record("multichip", "MULTICHIP_r07.json", bad)
+    assert any("chaos_resize" in p for p in problems)
+    worse = dict(rec, chaos_resize=17)
+    assert any("chaos_resize" in p for p in
+               validate_record("multichip", "MULTICHIP_r07.json", worse))
+
+
+def test_regress_tracks_post_resize_series():
+    from lightgbm_tpu.observability.regress import _multichip_points
+    records = [
+        (6, "MULTICHIP_r06.json",
+         {"rc": 0, "skipped": False, "trees_per_sec": 10.0}),
+        (7, "MULTICHIP_r07.json",
+         {"rc": 0, "skipped": False, "trees_per_sec": 11.0,
+          "chaos_resize": {"resizes": 1, "reshard_wall_s": 0.5,
+                           "post_resize_trees_per_sec": 9.0}}),
+    ]
+    series = _multichip_points(records)
+    assert series["multichip_trees_per_sec"] == [(6, 10.0), (7, 11.0)]
+    assert series["multichip_post_resize_trees_per_sec"] == [(7, 9.0)]
+    assert series["multichip_reshard_inv_wall"] == [(7, 2.0)]
+
+
+# ----------------------------------------------------------------------
+# the slow acceptance scenario: shrink-and-finish, byte-identical to a
+# fixed-world resume from the same epoch checkpoint
+
+ROUNDS = 8
+CKPT_PERIOD = 2
+TIMEOUT_S = 30.0
+DEATH_ITER = 5          # last coordinated commit lands at iteration 4
+
+
+@pytest.mark.slow
+def test_shrink_and_finish_matches_fixed_world_resume(tmp_path):
+    from lightgbm_tpu.testing.chaos import (run_chaos_training,
+                                            run_elastic_training,
+                                            strip_rank_local_params)
+    workdir = str(tmp_path / "elastic")
+    ckpt_dir = os.path.join(workdir, "ckpts")
+    out = run_elastic_training(
+        workdir, rounds=ROUNDS, ckpt_period=CKPT_PERIOD,
+        ckpt_dir=ckpt_dir, timeout_s=TIMEOUT_S, death_rank=1,
+        death_iter=DEATH_ITER, world=2)
+
+    # --- the resize happened, with ZERO aborts ----------------------
+    rec = out["record"]
+    assert rec is not None
+    assert (rec.epoch, rec.world, rec.members) == (1, 1, (0,))
+    assert out["final_world"] == 1
+    assert len(out["history"]) == 2          # one death, one relaunch
+    gen0, gen1 = out["history"]
+    rcs0 = sorted(r.returncode for r in gen0)
+    assert rcs0 == [75, 86], f"expected resize+death, got {rcs0}"
+    assert all(r.returncode == 0 for r in gen1)
+    assert not any(r.timed_out for r in gen0 + gen1)
+
+    # --- the finishing generation trained to completion -------------
+    final_model_path = os.path.join(workdir,
+                                    f"{out['out_prefix']}_0.txt")
+    with open(final_model_path) as f:
+        elastic_model = strip_rank_local_params(f.read())
+
+    # --- fixed-world parity run: same epoch bundle, same W'=1 -------
+    assert out["snapshot_dir"], "supervisor did not snapshot the bundle"
+    parity_dir = str(tmp_path / "parity")
+    parity = run_chaos_training(
+        parity_dir, rounds=ROUNDS, ckpt_period=CKPT_PERIOD,
+        ckpt_dir=out["snapshot_dir"], timeout_s=TIMEOUT_S,
+        world=1, elastic=True, resume=True, out_prefix="parity",
+        extra_env={"LIGHTGBM_TPU_EPOCH": str(rec.epoch)})
+    assert all(r.returncode == 0 for r in parity), \
+        "\n".join(r.tail() for r in parity)
+    with open(os.path.join(parity_dir, "parity_0.txt")) as f:
+        parity_model = strip_rank_local_params(f.read())
+
+    assert elastic_model == parity_model, \
+        "elastic-shrunk model differs from fixed-world resume"

@@ -214,6 +214,7 @@ def test_spmd_rules_fire():
         ("COLL002", 34),  # stranded_raise: bare raise, peers allgather
         ("COLL002", 44),  # pr7_bin_parity: the PR-7 bug shape
         ("COLL003", 50),  # ragged_gather: rows[:n] fed to allgather
+        ("COLL001", 58),  # resize_epoch_vote: coordinator-only gather
     }
 
 

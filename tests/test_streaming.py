@@ -369,11 +369,13 @@ class TestMapperSync:
         gathered = []
 
         def fake_allgather(tree):
-            # guarded_allgather ships (payload, wall-clock stamp): the
-            # real process_allgather maps over the pytree
-            arr, wall = tree
+            # guarded_allgather ships (payload, wall-clock stamp,
+            # membership epoch): the real process_allgather maps over
+            # the pytree
+            arr, wall, epoch = tree
             gathered.append(np.asarray(arr))
-            return np.asarray(arr)[None], np.asarray(wall)[None]
+            return (np.asarray(arr)[None], np.asarray(wall)[None],
+                    np.asarray(epoch)[None])
 
         monkeypatch.setattr(multihost_utils, "process_allgather",
                             fake_allgather)
