@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: test test-slow test-all faults chaos postmortem distributed observe lint lint-sarif pipeline kernels perf stream bench serve-chaos serve-bench loop loop-chaos elastic install
+.PHONY: test test-slow test-all faults chaos postmortem distributed observe lint lint-sarif lint-ci pipeline kernels perf stream bench serve-chaos serve-bench loop loop-chaos elastic install
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -22,6 +22,13 @@ lint:
 # same run, SARIF 2.1.0 on stdout — for CI diff annotators
 lint-sarif:
 	$(PY) -m lightgbm_tpu.analysis lightgbm_tpu --format=sarif
+
+# hermetic CI gate: cache disabled (every trace rebuilt from scratch),
+# human-readable text on stdout plus tpulint.sarif for annotators
+lint-ci:
+	$(PY) -m lightgbm_tpu.analysis lightgbm_tpu --no-cache
+	$(PY) -m lightgbm_tpu.analysis lightgbm_tpu --no-cache --format=sarif > tpulint.sarif
+	$(PY) -m pytest tests/test_static_analysis.py -x -q -m lint
 
 # the pipelined-executor tier: byte-parity vs the serial block loop,
 # device-eval fidelity, adaptive scheduler (tests/test_pipeline.py,

@@ -35,6 +35,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="also print suppressed findings (text mode)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalogue and exit")
+    parser.add_argument("--no-interproc", action="store_true",
+                        help="disable the cross-function call-graph "
+                             "engine (intraprocedural findings only)")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="bypass the .tpulint_cache/ incremental "
+                             "store (CI runs hermetic)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -43,7 +49,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     paths = args.paths or _default_paths()
-    analyzer = Analyzer()
+    analyzer = Analyzer(interproc=not args.no_interproc,
+                        cache=not args.no_cache)
     findings = analyzer.run(paths)
     if args.format == "json":
         print(Analyzer.render_json(findings))

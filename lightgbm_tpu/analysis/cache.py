@@ -1,0 +1,169 @@
+"""Incremental lint cache: content-hash keyed results under
+``.tpulint_cache/``.
+
+Two result classes are cached:
+
+- **per-file findings**: the output of every per-file rule on one
+  source file, keyed by the file's content hash *plus* the content
+  hashes of every scanned file it imports (callgraph.file_deps) — an
+  interprocedural finding in caller.py can appear or vanish when only
+  callee.py changes, so dependents invalidate.
+- **trace reports**: tracecheck results per manifest entry, keyed by
+  the entry name, its contract, and the content hashes of the entry's
+  declared source deps. Tracing is the expensive part of a lint run
+  (~5s for the fused train program); a warm cache keeps the
+  full-package lint inside the tier-1 wall budget.
+
+Every key also folds in a *rules signature* — the content hash of
+every module in ``lightgbm_tpu/analysis/`` — plus the jax version, so
+editing any rule or bumping jax invalidates everything at once.
+
+The cache only activates for real package scans (the Analyzer enables
+it when ``config.py`` is in the scan set) and lives at the repo root;
+fixture scans under tests/ never sprinkle cache directories around.
+Writes are atomic (temp file + ``os.replace``) so concurrent lint
+runs at worst redo work. ``--no-cache`` (or ``Analyzer(cache=False)``)
+bypasses it entirely — CI uses that to keep the gate hermetic.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["CACHE_DIR_NAME", "LintCache", "rules_signature"]
+
+CACHE_DIR_NAME = ".tpulint_cache"
+_FORMAT_VERSION = "1"
+
+
+def _sha(*parts: str) -> str:
+    h = hashlib.sha256()
+    for part in parts:
+        h.update(part.encode("utf-8", "replace"))
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+def rules_signature() -> str:
+    """Content hash of the analysis package itself (rule edits
+    invalidate every cached result) plus the jax version."""
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    parts: List[str] = [_FORMAT_VERSION]
+    try:
+        names = sorted(n for n in os.listdir(pkg) if n.endswith(".py"))
+    except OSError:
+        names = []
+    for name in names:
+        try:
+            with open(os.path.join(pkg, name), "rb") as fh:
+                parts.append(hashlib.sha256(fh.read()).hexdigest())
+        except OSError:
+            parts.append(f"unreadable:{name}")
+    try:
+        import jax
+        parts.append(f"jax:{jax.__version__}")
+    except Exception:
+        parts.append("jax:none")
+    return _sha(*parts)
+
+
+class LintCache:
+    """Content-addressed result store rooted at ``<repo>/.tpulint_cache``."""
+
+    def __init__(self, repo_root: str):
+        self.root = os.path.join(repo_root, CACHE_DIR_NAME)
+        self.repo_root = repo_root
+        self.rules_sig = rules_signature()
+        self.hits = 0
+        self.misses = 0
+        self._content_hashes: Dict[str, str] = {}
+
+    # ------------------------------------------------------------------
+    def content_hash(self, path: str) -> str:
+        path = os.path.abspath(path)
+        cached = self._content_hashes.get(path)
+        if cached is not None:
+            return cached
+        try:
+            with open(path, "rb") as fh:
+                digest = hashlib.sha256(fh.read()).hexdigest()
+        except OSError:
+            digest = "unreadable"
+        self._content_hashes[path] = digest
+        return digest
+
+    def _rel(self, path: str) -> str:
+        try:
+            return os.path.relpath(os.path.abspath(path), self.repo_root)
+        except ValueError:
+            return path
+
+    def _dep_fingerprint(self, deps: Sequence[str]) -> str:
+        pairs = sorted((self._rel(d), self.content_hash(d))
+                       for d in deps)
+        return _sha(*[f"{r}={h}" for r, h in pairs])
+
+    # -- keys ----------------------------------------------------------
+    def file_key(self, path: str, deps: Sequence[str],
+                 interproc: bool) -> str:
+        return _sha("file", self.rules_sig, self._rel(path),
+                    self.content_hash(path), str(bool(interproc)),
+                    self._dep_fingerprint(deps))
+
+    def trace_key(self, entry_name: str, deps: Sequence[str],
+                  contract: str) -> str:
+        abs_deps = [os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), d) for d in deps]
+        return _sha("trace", self.rules_sig, entry_name, contract,
+                    self._dep_fingerprint(abs_deps))
+
+    # -- storage -------------------------------------------------------
+    def _path_for(self, key: str) -> str:
+        return os.path.join(self.root, key[:2], key + ".json")
+
+    def _get(self, key: str):
+        try:
+            with open(self._path_for(key), "r") as fh:
+                payload = json.load(fh)
+        except (OSError, ValueError):
+            self.misses += 1
+            return None
+        self.hits += 1
+        return payload
+
+    def _put(self, key: str, payload) -> None:
+        path = self._path_for(key)
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                       suffix=".tmp")
+            with os.fdopen(fd, "w") as fh:
+                json.dump(payload, fh)
+            os.replace(tmp, path)
+        except OSError:
+            pass                         # cache is best-effort
+
+    # -- typed views ---------------------------------------------------
+    def get_file_findings(self, key: str) -> Optional[List[Dict]]:
+        payload = self._get(key)
+        if isinstance(payload, dict) and \
+                isinstance(payload.get("findings"), list):
+            return payload["findings"]
+        return None
+
+    def put_file_findings(self, key: str,
+                          findings: List[Dict]) -> None:
+        self._put(key, {"findings": findings})
+
+    def get_trace_report(self, key: str) -> Optional[Dict]:
+        payload = self._get(key)
+        if isinstance(payload, dict) and "name" in payload:
+            return payload
+        return None
+
+    def put_trace_report(self, key: str, report: Dict) -> None:
+        self._put(key, report)

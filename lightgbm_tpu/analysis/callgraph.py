@@ -1,0 +1,455 @@
+"""Interprocedural facts: project call graph + cross-function summaries.
+
+The per-file rules are deliberately intraprocedural — fast, local,
+predictable. But the failure modes the ROADMAP calls out (hidden host
+syncs, stranded collectives) do not respect function boundaries: the
+`float()` that serializes a jitted body usually lives in a helper two
+modules away. This module builds the minimum interprocedural machinery
+the upgraded rules need, as *facts* handed to the existing rules (the
+rules keep their ids and their intraprocedural behavior; facts only add
+findings):
+
+- a project-wide call graph over the already-parsed files, with
+  file-path-based import resolution (``from .m import f``,
+  ``import pkg.mod as m`` + ``m.f()``, bare local calls, and
+  ``self.method()`` within a class);
+- **host-sync summaries** (for JIT003): per function, which *parameters*
+  flow into a host-syncing call (``float()``, ``.item()``, ``np.*`` —
+  the same label set as the lexical rule), propagated bottom-up through
+  the call graph with a bounded, cycle-safe fixpoint. A jitted body
+  passing a traced value into such a parameter is a host sync the
+  lexical rule provably cannot see.
+- **collective reachability** (for COLL001/002/003): the set of local
+  call spellings in each module that transitively perform a collective
+  (`dataflow.COLLECTIVE_CALLABLES`), so the taint/CFG rules treat
+  ``sync_error_count(x)`` exactly like the ``psum`` hiding inside it.
+- **``_locked`` delegation resolution** (for LOCK001): calls to
+  ``*_locked``-suffixed functions resolved across modules, so the
+  caller-holds-the-lock naming contract is checked at every delegation
+  edge, not just inside one class body.
+
+Approximations, documented so rule behavior stays predictable: calls
+through arbitrary objects (``obj.m()`` where ``obj`` is not ``self``,
+``cls`` or an imported module alias) are unresolved; provenance through
+container stores is not tracked; the fixpoint is bounded at
+``MAX_DEPTH`` propagation rounds, which caps summary chains without
+risking non-termination on call cycles.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from .dataflow import COLLECTIVE_CALLABLES, call_name, dotted_name
+from .engine import ParsedFile
+
+__all__ = ["FunctionInfo", "InterprocFacts", "MAX_DEPTH"]
+
+#: bounded propagation depth for the bottom-up summary fixpoint — deep
+#: enough for any sane helper chain, finite on call cycles
+MAX_DEPTH = 6
+
+#: host-sync labels (mirrors rules_jit: builtins that concretize, sync
+#: methods, numpy namespace calls)
+_HOST_SYNC_FUNCS = ("float", "int", "bool", "complex")
+_HOST_SYNC_METHODS = ("item", "tolist", "to_py")
+_HOST_MODULES = ("np", "numpy")
+
+#: attribute reads that are static at trace time (mirrors rules_jit)
+_STATIC_ATTRS = ("shape", "ndim", "dtype", "size")
+
+
+def _tainted_sources(e: ast.AST, taint: Dict[str, Set[str]]) -> Set[str]:
+    """Union of param sets mentioned by `e`, skipping trace-static
+    reads: `x.shape[...]` and `is None` tests never carry a traced
+    value into a host sync (same exemptions as the lexical JIT003)."""
+    out: Set[str] = set()
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.Compare) and node.ops and \
+                all(isinstance(op, (ast.Is, ast.IsNot))
+                    for op in node.ops):
+            return
+        if isinstance(node, ast.Attribute) and \
+                node.attr in _STATIC_ATTRS:
+            return
+        if isinstance(node, ast.Name):
+            out.update(taint.get(node.id, ()))
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(e)
+    return out
+
+
+def _host_call_label(node: ast.Call) -> Optional[str]:
+    fn = node.func
+    if isinstance(fn, ast.Name) and fn.id in _HOST_SYNC_FUNCS:
+        return f"{fn.id}()"
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in _HOST_SYNC_METHODS:
+            return f".{fn.attr}()"
+        base = dotted_name(fn.value)
+        if base in _HOST_MODULES:
+            return f"{base}.{fn.attr}()"
+    return None
+
+
+class FunctionInfo:
+    """One function or method in the scanned set."""
+
+    __slots__ = ("path", "qualname", "name", "node", "class_name",
+                 "params", "host_sync_params", "reaches_collective")
+
+    def __init__(self, path: str, qualname: str, node: ast.FunctionDef,
+                 class_name: Optional[str]):
+        self.path = path
+        self.qualname = qualname
+        self.name = node.name
+        self.node = node
+        self.class_name = class_name
+        self.params = [a.arg for a in
+                       list(node.args.posonlyargs) + list(node.args.args)
+                       + list(node.args.kwonlyargs)]
+        #: param name -> (label, path, line) of the host sync it feeds
+        self.host_sync_params: Dict[str, Tuple[str, str, int]] = {}
+        self.reaches_collective = False
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.path, self.qualname)
+
+
+def _module_file_of(path: str, dots: int, mod_parts: List[str],
+                    known: Set[str]) -> Optional[str]:
+    """Resolve an import to a scanned file path.
+
+    `dots` is the relative-import level (0 = absolute). Absolute
+    imports are matched by path suffix against the scanned set (the
+    analyzer has no sys.path; a trailing-components match is exact
+    enough inside one repository)."""
+    if dots:
+        base = os.path.dirname(os.path.abspath(path))
+        for _ in range(dots - 1):
+            base = os.path.dirname(base)
+        cand = os.path.join(base, *mod_parts) + ".py" if mod_parts \
+            else None
+        if cand is not None and cand in known:
+            return cand
+        if mod_parts:
+            pkg = os.path.join(base, *mod_parts, "__init__.py")
+            if pkg in known:
+                return pkg
+        return None
+    if not mod_parts:
+        return None
+    suffix = os.sep.join(mod_parts) + ".py"
+    pkg_suffix = os.sep.join(mod_parts + ["__init__.py"])
+    for cand in known:
+        if cand.endswith(os.sep + suffix) or cand == suffix or \
+                cand.endswith(os.sep + pkg_suffix):
+            return cand
+    return None
+
+
+class InterprocFacts:
+    """Call graph + summaries over one analyzer run's parsed files."""
+
+    def __init__(self, files: Sequence[ParsedFile]):
+        self.files = [f for f in files if f.tree is not None]
+        self._paths: Set[str] = {os.path.abspath(f.path)
+                                 for f in self.files}
+        #: (path, qualname) -> FunctionInfo
+        self.functions: Dict[Tuple[str, str], FunctionInfo] = {}
+        #: path -> {local top-level function name -> qualname}
+        self._top: Dict[str, Dict[str, str]] = {}
+        #: path -> {class name -> {method name -> qualname}}
+        self._methods: Dict[str, Dict[str, Dict[str, str]]] = {}
+        #: path -> {alias -> ("func", target_path, name) |
+        #:          ("module", target_path)}
+        self._imports: Dict[str, Dict[str, Tuple]] = {}
+        for parsed in self.files:
+            self._index_file(parsed)
+        # the summary fixpoint is the expensive part and is only
+        # consulted by rules on a cache miss — computed on first use so
+        # a fully-cached scan pays for indexing (file_deps) alone
+        self._summaries_done = False
+
+    def _ensure_summaries(self) -> None:
+        if not self._summaries_done:
+            self._summaries_done = True
+            self._resolve_summaries()
+
+    # -- indexing -------------------------------------------------------
+    def _index_file(self, parsed: ParsedFile) -> None:
+        path = os.path.abspath(parsed.path)
+        top: Dict[str, str] = {}
+        methods: Dict[str, Dict[str, str]] = {}
+        for node in parsed.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = FunctionInfo(path, node.name, node, None)
+                self.functions[info.key] = info
+                top[node.name] = node.name
+            elif isinstance(node, ast.ClassDef):
+                meths: Dict[str, str] = {}
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        qn = f"{node.name}.{sub.name}"
+                        info = FunctionInfo(path, qn, sub, node.name)
+                        self.functions[info.key] = info
+                        meths[sub.name] = qn
+                methods[node.name] = meths
+        self._top[path] = top
+        self._methods[path] = methods
+        imports: Dict[str, Tuple] = {}
+        for node in ast.walk(parsed.tree):
+            if isinstance(node, ast.ImportFrom):
+                tgt = _module_file_of(path, node.level,
+                                      (node.module or "").split(".")
+                                      if node.module else [],
+                                      self._paths)
+                if tgt is None:
+                    continue
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    imports[local] = ("func", tgt, alias.name)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    tgt = _module_file_of(path, 0, alias.name.split("."),
+                                          self._paths)
+                    if tgt is None:
+                        continue
+                    local = alias.asname or alias.name.split(".")[-1]
+                    imports[local] = ("module", tgt)
+        self._imports[path] = imports
+
+    # -- call resolution ------------------------------------------------
+    def resolve_call(self, path: str, call: ast.Call,
+                     class_name: Optional[str] = None
+                     ) -> Optional[FunctionInfo]:
+        """The FunctionInfo a call resolves to, or None (opaque)."""
+        path = os.path.abspath(path)
+        name = dotted_name(call.func)
+        if not name:
+            return None
+        parts = name.split(".")
+        if len(parts) == 1:
+            return self._resolve_name(path, parts[0])
+        if parts[0] in ("self", "cls") and len(parts) == 2 and \
+                class_name is not None:
+            qn = self._methods.get(path, {}).get(class_name, {}) \
+                .get(parts[1])
+            if qn is not None:
+                return self.functions.get((path, qn))
+            return None
+        # module-alias form: m.f() / pkg.mod.f() via `import ... as m`
+        entry = self._imports.get(path, {}).get(parts[0])
+        if entry is not None and entry[0] == "module" and len(parts) == 2:
+            tgt = entry[1]
+            qn = self._top.get(tgt, {}).get(parts[1])
+            if qn is not None:
+                return self.functions.get((tgt, qn))
+        return None
+
+    def _resolve_name(self, path: str,
+                      name: str) -> Optional[FunctionInfo]:
+        qn = self._top.get(path, {}).get(name)
+        if qn is not None:
+            return self.functions.get((path, qn))
+        entry = self._imports.get(path, {}).get(name)
+        if entry is not None and entry[0] == "func":
+            _, tgt, fname = entry
+            tqn = self._top.get(tgt, {}).get(fname)
+            if tqn is not None:
+                return self.functions.get((tgt, tqn))
+        return None
+
+    # -- summaries ------------------------------------------------------
+    def _direct_host_syncs(self, info: FunctionInfo
+                           ) -> Dict[str, Tuple[str, str, int]]:
+        """Params of `info` that flow into a direct host-sync call.
+
+        Flow-insensitive name taint: a param name, or a local assigned
+        from an expression mentioning a tainted name, carries the
+        originating param set."""
+        taint: Dict[str, Set[str]] = {p: {p} for p in info.params
+                                      if p != "self"}
+
+        def expr_sources(e: ast.AST) -> Set[str]:
+            return _tainted_sources(e, taint)
+
+        for _ in range(4):
+            changed = False
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.Assign):
+                    src = expr_sources(node.value)
+                    if not src:
+                        continue
+                    for tgt in node.targets:
+                        for n in ast.walk(tgt):
+                            if isinstance(n, ast.Name):
+                                cur = taint.setdefault(n.id, set())
+                                if not src <= cur:
+                                    cur |= src
+                                    changed = True
+            if not changed:
+                break
+        out: Dict[str, Tuple[str, str, int]] = {}
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            label = _host_call_label(node)
+            if label is None:
+                continue
+            exprs = list(node.args) + [kw.value for kw in node.keywords]
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _HOST_SYNC_METHODS:
+                exprs.append(node.func.value)
+            for e in exprs:
+                for p in expr_sources(e):
+                    out.setdefault(p, (label, info.path, node.lineno))
+        return out
+
+    def _call_param_map(self, caller: FunctionInfo, call: ast.Call,
+                        callee: FunctionInfo
+                        ) -> List[Tuple[str, ast.expr]]:
+        """(callee param name, argument expression) pairs for a call."""
+        params = [p for p in callee.params if p != "self"]
+        out: List[Tuple[str, ast.expr]] = []
+        for idx, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                break
+            if idx < len(params):
+                out.append((params[idx], arg))
+        for kw in call.keywords:
+            if kw.arg is not None and kw.arg in params:
+                out.append((kw.arg, kw.value))
+        return out
+
+    def _resolve_summaries(self) -> None:
+        # seed: direct host syncs and direct collective calls
+        direct_sync: Dict[Tuple[str, str],
+                          Dict[str, Tuple[str, str, int]]] = {}
+        for key, info in self.functions.items():
+            direct_sync[key] = self._direct_host_syncs(info)
+            info.host_sync_params = dict(direct_sync[key])
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.Call) and \
+                        call_name(node) in COLLECTIVE_CALLABLES:
+                    info.reaches_collective = True
+                    break
+        # bounded bottom-up propagation: caller param -> callee syncing
+        # param, and collective reachability through resolved edges.
+        # Monotone, so MAX_DEPTH rounds is both the cycle guard and the
+        # summary-depth bound.
+        for _ in range(MAX_DEPTH):
+            changed = False
+            for key, info in self.functions.items():
+                caller_taint = {p: {p} for p in info.params
+                                if p != "self"}
+                for node in ast.walk(info.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = self.resolve_call(info.path, node,
+                                               info.class_name)
+                    if callee is None or callee is info:
+                        continue
+                    if callee.reaches_collective and \
+                            not info.reaches_collective:
+                        info.reaches_collective = True
+                        changed = True
+                    if not callee.host_sync_params:
+                        continue
+                    for pname, arg in self._call_param_map(
+                            info, node, callee):
+                        hit = callee.host_sync_params.get(pname)
+                        if hit is None:
+                            continue
+                        label, spath, sline = hit
+                        for src in _tainted_sources(arg, caller_taint):
+                            if src not in info.host_sync_params:
+                                info.host_sync_params[src] = (
+                                    label, spath, node.lineno)
+                                changed = True
+            if not changed:
+                break
+
+    # -- rule-facing queries --------------------------------------------
+    def collective_call_names(self, path: str) -> FrozenSet[str]:
+        """Call-site spellings (last dotted segment) in `path` that
+        resolve to a function which transitively performs a collective.
+        Fed to the SPMD rules as extra collective callables."""
+        self._ensure_summaries()
+        path = os.path.abspath(path)
+        out: Set[str] = set()
+        for alias, entry in self._imports.get(path, {}).items():
+            if entry[0] == "func":
+                _, tgt, fname = entry
+                qn = self._top.get(tgt, {}).get(fname)
+                if qn is not None:
+                    info = self.functions.get((tgt, qn))
+                    if info is not None and info.reaches_collective:
+                        out.add(alias)
+            elif entry[0] == "module":
+                for fname, qn in self._top.get(entry[1], {}).items():
+                    info = self.functions.get((entry[1], qn))
+                    if info is not None and info.reaches_collective:
+                        out.add(fname)
+        for fname, qn in self._top.get(path, {}).items():
+            info = self.functions.get((path, qn))
+            if info is not None and info.reaches_collective and \
+                    info.name not in COLLECTIVE_CALLABLES:
+                out.add(fname)
+        return frozenset(out)
+
+    def host_sync_callees(self, path: str, root: ast.AST,
+                          class_name: Optional[str] = None
+                          ) -> List[Tuple[ast.Call, FunctionInfo,
+                                          List[Tuple[str, ast.expr]]]]:
+        """Calls under `root` whose resolved callee host-syncs one of
+        its parameters: (call, callee, [(syncing param, arg expr)])."""
+        self._ensure_summaries()
+        out = []
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = self.resolve_call(path, node, class_name)
+            if callee is None or not callee.host_sync_params:
+                continue
+            hits = [(p, arg) for p, arg in
+                    self._call_param_map(None, node, callee)
+                    if p in callee.host_sync_params]
+            if hits:
+                out.append((node, callee, hits))
+        return out
+
+    def locked_delegate_calls(self, path: str, root: ast.AST,
+                              class_name: Optional[str] = None
+                              ) -> List[Tuple[ast.Call, FunctionInfo]]:
+        """Calls under `root` that resolve to a ``*_locked``-suffixed
+        function (the caller-holds-the-lock delegation contract)."""
+        self._ensure_summaries()
+        out = []
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call):
+                continue
+            if not call_name(node).endswith("_locked"):
+                continue
+            callee = self.resolve_call(path, node, class_name)
+            if callee is not None and callee.name.endswith("_locked"):
+                out.append((node, callee))
+        return out
+
+    def file_deps(self, path: str) -> List[str]:
+        """Scanned files this module's findings may depend on (its
+        resolved imports) — the cache invalidation set."""
+        path = os.path.abspath(path)
+        deps: Set[str] = set()
+        for entry in self._imports.get(path, {}).values():
+            deps.add(entry[1])
+        deps.discard(path)
+        return sorted(deps)

@@ -176,11 +176,14 @@ def iter_top_functions(tree: ast.AST) -> Iterator[ast.FunctionDef]:
                     yield sub
 
 
-def collective_calls(root: ast.AST) -> List[ast.Call]:
-    """Every call under `root` whose callee name is a collective."""
+def collective_calls(root: ast.AST,
+                     extra: frozenset = frozenset()) -> List[ast.Call]:
+    """Every call under `root` whose callee name is a collective.
+    `extra` adds interprocedurally-resolved names (local spellings that
+    transitively perform a collective, callgraph.collective_call_names)."""
+    names = COLLECTIVE_CALLABLES | extra if extra else COLLECTIVE_CALLABLES
     return [node for node in ast.walk(root)
-            if isinstance(node, ast.Call)
-            and call_name(node) in COLLECTIVE_CALLABLES]
+            if isinstance(node, ast.Call) and call_name(node) in names]
 
 
 # ---------------------------------------------------------------------------
@@ -305,9 +308,11 @@ class RankTaint:
     `shape_seeds=False` (device code) disables the `.shape`/`len()`
     value seeds; rank-identity calls still seed everywhere."""
 
-    def __init__(self, fn: ast.FunctionDef, shape_seeds: bool = True):
+    def __init__(self, fn: ast.FunctionDef, shape_seeds: bool = True,
+                 extra_collectives: frozenset = frozenset()):
         self.fn = fn
         self.shape_seeds = shape_seeds
+        self.collectives = COLLECTIVE_CALLABLES | extra_collectives
         self.value: Set[str] = set()
         self.shape: Set[str] = set()
         # name -> list of ("expr"|"iter", rhs expression) descriptors
@@ -395,6 +400,14 @@ class RankTaint:
             base = target
             while isinstance(base, (ast.Subscript, ast.Attribute)):
                 base = base.value
+            # attribute stores on self/cls do NOT taint the whole
+            # object: `self.label = <tainted>` says nothing about
+            # `self.data`, and whole-object taint cascades through
+            # every other attribute read in the method
+            if isinstance(target, ast.Attribute) and \
+                    isinstance(base, ast.Name) and \
+                    base.id in ("self", "cls"):
+                return
             if isinstance(base, ast.Name):
                 self._stores.append((base.id, rhs))
 
@@ -530,7 +543,7 @@ class RankTaint:
             av, ash = av or v, ash or s
         if fname in RANK_SOURCES:
             return (True, False)
-        if fname in COLLECTIVE_CALLABLES:
+        if fname in self.collectives:
             return (False, False)          # rank-uniform result
         if fname in SHAPE_SANITIZERS:
             return (av, False)             # static shape by construction

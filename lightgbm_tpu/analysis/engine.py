@@ -275,6 +275,9 @@ def all_rules() -> List[Rule]:
                                  PrometheusDocsRule)
     from .rules_spmd import (CollectiveBranchRule, CollectiveRaiseRule,
                              CollectiveRegistryRule, CollectiveShapeRule)
+    from .rules_trace import (TraceCallbackRule, TraceDonationRule,
+                              TraceF64Rule, TraceManifestCoverageRule,
+                              TraceRetraceStableRule, TraceSortFreeRule)
     rules: List[Rule] = [
         JitStaticScalarRule(), JitPythonControlFlowRule(),
         JitHostSyncRule(), JitDonationReuseRule(),
@@ -288,15 +291,29 @@ def all_rules() -> List[Rule]:
         CollectiveBranchRule(), CollectiveRaiseRule(),
         CollectiveShapeRule(), CollectiveRegistryRule(),
         StaleSuppressionRule(),
+        TraceSortFreeRule(), TraceF64Rule(), TraceCallbackRule(),
+        TraceDonationRule(), TraceRetraceStableRule(),
+        TraceManifestCoverageRule(),
     ]
     return sorted(rules, key=lambda r: r.id)
 
 
 class Analyzer:
-    """Run every rule over the target paths; collect findings."""
+    """Run every rule over the target paths; collect findings.
 
-    def __init__(self, rules: Optional[Sequence[Rule]] = None):
+    `interproc=False` drops the cross-function call-graph facts (the
+    per-file rules fall back to their intraprocedural behaviour —
+    tests use this to prove which findings only the interprocedural
+    engine sees). `cache=False` bypasses the `.tpulint_cache/`
+    incremental store; with the default `cache=True` the cache only
+    activates when the scan set contains the analyzer's own package
+    (its config.py), so fixture scans never touch disk."""
+
+    def __init__(self, rules: Optional[Sequence[Rule]] = None,
+                 interproc: bool = True, cache: bool = True):
         self.rules = list(rules) if rules is not None else all_rules()
+        self.interproc = interproc
+        self.cache = cache
 
     # ------------------------------------------------------------------
     def parse_paths(self, paths: Iterable[str]) -> List[ParsedFile]:
@@ -315,6 +332,29 @@ class Analyzer:
     def run(self, paths: Iterable[str]) -> List[Finding]:
         files = self.parse_paths(paths)
         ctx = ProjectContext(files)
+        # interprocedural facts: call graph + cross-function host-sync /
+        # collective / lock summaries, shared by JIT003/COLL00x/LOCK001
+        facts = None
+        if self.interproc:
+            from .callgraph import InterprocFacts
+            facts = InterprocFacts(files)
+        ctx.facts = facts
+        for rule in self.rules:
+            rule.facts = facts
+        # the incremental cache only engages when the scan set contains
+        # the analyzer's own package (not a fixture mini-project that
+        # happens to ship a config.py) so fixture runs under tests/
+        # never create cache directories
+        cache = None
+        own_pkg = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        if self.cache and any(
+                os.path.basename(f.path) == "config.py"
+                and os.path.dirname(os.path.abspath(f.path)) == own_pkg
+                for f in files):
+            from .cache import LintCache
+            cache = LintCache(ctx.repo_root)
+        ctx.lint_cache = cache
         findings: List[Finding] = []
         by_path = {f.path: f for f in files}
         for parsed in files:
@@ -324,8 +364,24 @@ class Analyzer:
                     line=1,
                     message=f"file does not parse: {parsed.parse_error}"))
                 continue
+            key = None
+            if cache is not None:
+                deps = facts.file_deps(parsed.path) if facts else ()
+                key = cache.file_key(parsed.path, deps,
+                                     self.interproc)
+                hit = cache.get_file_findings(key)
+                if hit is not None:
+                    findings.extend(Finding(**d) for d in hit)
+                    continue
+            file_findings: List[Finding] = []
             for rule in self.rules:
-                findings.extend(rule.check(parsed))
+                file_findings.extend(rule.check(parsed))
+            if key is not None:
+                # stored pre-suppression-marking: the marking pass below
+                # is deterministic in (path, content), so replay is exact
+                cache.put_file_findings(
+                    key, [f.to_dict() for f in file_findings])
+            findings.extend(file_findings)
         for rule in self.rules:
             if isinstance(rule, ProjectRule):
                 findings.extend(rule.check_project(files, ctx))

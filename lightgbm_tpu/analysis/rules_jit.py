@@ -24,6 +24,7 @@ What does NOT fire, by design:
 from __future__ import annotations
 
 import ast
+import os
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from .dataflow import branch_tests, dotted_name
@@ -171,6 +172,21 @@ def _offending_names(expr: ast.expr, traced: Set[str]) -> List[ast.Name]:
     return out
 
 
+def _enclosing_classes(parsed: ParsedFile) -> Dict[int, str]:
+    """id(method node) -> enclosing class name, for call resolution of
+    `self.m()` inside jitted methods."""
+    out: Dict[int, str] = {}
+    if parsed.tree is None:
+        return out
+    for node in ast.walk(parsed.tree):
+        if isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    out[id(sub)] = node.name
+    return out
+
+
 def _jit_bodies(parsed: ParsedFile):
     """(func, traced_param_names) for each jit entry in a device file."""
     if parsed.tree is None or not parsed.in_device_dir():
@@ -252,10 +268,14 @@ class JitHostSyncRule(Rule):
     id = "JIT003"
     doc = ("float()/int()/bool()/.item()/np.* applied to a traced value "
            "inside a jitted body — forces a device->host sync at trace "
-           "time (or a concretization error)")
+           "time (or a concretization error); with the interprocedural "
+           "engine, also when the sync happens inside a helper the "
+           "traced value is passed to")
 
     def check(self, parsed: ParsedFile) -> List[Finding]:
         findings: List[Finding] = []
+        facts = getattr(self, "facts", None)
+        class_of = _enclosing_classes(parsed) if facts is not None else {}
         for func, _static, traced in _jit_bodies(parsed):
             for node in ast.walk(func):
                 if not isinstance(node, ast.Call):
@@ -282,6 +302,26 @@ class JitHostSyncRule(Rule):
                         parsed, node.lineno,
                         f"jitted function '{func.name}': host sync "
                         f"'{label}' on traced value '{hit.id}'"))
+            if facts is None:
+                continue
+            # interprocedural: the sync lives in a helper (possibly
+            # modules away); flag the call site that feeds a traced
+            # value into the helper's syncing parameter
+            for call, callee, hits in facts.host_sync_callees(
+                    parsed.path, func, class_of.get(id(func))):
+                for pname, arg in hits:
+                    names = _offending_names(arg, traced)
+                    if not names:
+                        continue
+                    label, spath, sline = callee.host_sync_params[pname]
+                    where = os.path.basename(spath)
+                    findings.append(self.finding(
+                        parsed, call.lineno,
+                        f"jitted function '{func.name}': traced value "
+                        f"'{names[0].id}' reaches host sync '{label}' "
+                        f"through '{callee.name}()' parameter "
+                        f"'{pname}' ({where}:{sline})"))
+                    break
         return findings
 
     @staticmethod

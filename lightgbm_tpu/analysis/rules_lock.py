@@ -28,9 +28,10 @@ conservative: names that collide with builtin container methods
 from __future__ import annotations
 
 import ast
+import os
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
-from .dataflow import child_blocks, stmt_exprs
+from .dataflow import child_blocks, dotted_name, stmt_exprs
 from .engine import Finding, ParsedFile, ProjectContext, ProjectRule, Rule
 
 __all__ = ["LockDisciplineRule", "LockOrderRule", "collect_lock_classes"]
@@ -135,6 +136,24 @@ class LockClass:
         return False
 
 
+def _lock_held_spans(fn: ast.AST) -> List[Tuple[int, int]]:
+    """Line spans inside `with <something named *lock*>:` blocks —
+    the held-context heuristic for the `_locked` delegation check."""
+    spans: List[Tuple[int, int]] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            name = dotted_name(item.context_expr)
+            if not name and isinstance(item.context_expr, ast.Call):
+                name = dotted_name(item.context_expr.func)
+            if "lock" in name.lower():
+                end = getattr(node, "end_lineno", None) or node.lineno
+                spans.append((node.lineno, end))
+                break
+    return spans
+
+
 def collect_lock_classes(parsed: ParsedFile) -> List[LockClass]:
     if parsed.tree is None:
         return []
@@ -234,7 +253,9 @@ class LockDisciplineRule(Rule):
     doc = ("read/write of a lock-guarded underscore attribute outside "
            "`with self._lock:` in a class that creates self._lock — "
            "torn reads / lost updates under the serving and "
-           "observability threads")
+           "observability threads; with the interprocedural engine, "
+           "also calls into `*_locked` helpers (caller-holds-the-lock "
+           "contract, resolved across modules) from lock-free contexts")
 
     def check(self, parsed: ParsedFile) -> List[Finding]:
         findings: List[Finding] = []
@@ -253,6 +274,48 @@ class LockDisciplineRule(Rule):
                     f"{cls.name}.{method}: access to guarded attribute "
                     f"'self.{attr}' outside `with self.<lock>:` "
                     f"(guarded because it is written post-__init__)"))
+        findings.extend(self._check_delegation(parsed))
+        return findings
+
+    # -- interprocedural `_locked` delegation ---------------------------
+    def _check_delegation(self, parsed: ParsedFile) -> List[Finding]:
+        """The `_locked` suffix is a contract: the caller holds the
+        lock. With call-graph facts the contract is checked at every
+        delegation edge, even when the helper lives in another module.
+        Held-context heuristic: textually inside a `with` whose context
+        expression names a lock (`self._lock`, `registry_lock`, ...).
+        Callers that are themselves `_locked` (or __init__/__del__,
+        where no other thread can race) inherit the contract upward."""
+        facts = getattr(self, "facts", None)
+        if facts is None or parsed.tree is None:
+            return []
+        class_of: Dict[int, str] = {}
+        for node in ast.walk(parsed.tree):
+            if isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        class_of[id(sub)] = node.name
+        findings: List[Finding] = []
+        for node in ast.walk(parsed.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if node.name.endswith("_locked") or \
+                    node.name in ("__init__", "__del__"):
+                continue
+            held = _lock_held_spans(node)
+            for call, callee in facts.locked_delegate_calls(
+                    parsed.path, node, class_of.get(id(node))):
+                if any(lo <= call.lineno <= hi for lo, hi in held):
+                    continue
+                where = os.path.basename(callee.path)
+                findings.append(self.finding(
+                    parsed, call.lineno,
+                    f"'{node.name}' calls '{callee.name}' "
+                    f"({where}:{callee.node.lineno}) without holding a "
+                    f"lock — the '_locked' suffix contract requires "
+                    f"the caller to hold the lock"))
         return findings
 
 

@@ -21,6 +21,13 @@ partition path.
 Functions not in the manifest do not fire: argsort is a fine tool in
 host-side setup (bin boundary construction, EFB greedy bundling) where
 it runs once per Dataset rather than once per level.
+
+Since the TRACE family landed, PERF001 is the *lexical fallback*: the
+authoritative sort-free guarantee is TRACE001, which traces the hot
+entries to jaxprs and rejects the `sort` primitive however it was
+spelled or wherever the helper lives. PERF001 stays because it is
+instant, points at the exact offending source line, and works on code
+that does not trace yet.
 """
 
 from __future__ import annotations
@@ -68,7 +75,8 @@ class PerfHotPathSortRule(Rule):
            "function (HOT_PATH_MANIFEST, rules_perf.py) — the scan "
            "partition made these paths row-linear; route the ordering "
            "through partition_rows(impl='scan') or, for a retained "
-           "parity oracle, suppress the exact line")
+           "parity oracle, suppress the exact line (lexical fallback; "
+           "TRACE001 checks the traced program)")
 
     def check(self, parsed: ParsedFile) -> List[Finding]:
         if parsed.tree is None or not parsed.in_device_dir():

@@ -52,12 +52,22 @@ __all__ = ["CollectiveBranchRule", "CollectiveRaiseRule",
 # shared per-function analysis (memoized: three rules share it)
 
 class _FunctionAnalysis:
-    """CFG + taint + guard chains for one top-level function."""
+    """CFG + taint + guard chains for one top-level function.
 
-    def __init__(self, fn: ast.FunctionDef, shape_seeds: bool):
+    `extra` carries interprocedurally-resolved collective spellings
+    (helpers that transitively psum/allgather, from
+    callgraph.collective_call_names) — the taint launder, the
+    reachability sets and the participate-before check all treat them
+    exactly like the base collectives."""
+
+    def __init__(self, fn: ast.FunctionDef, shape_seeds: bool,
+                 extra: frozenset = frozenset()):
         self.fn = fn
+        self.all_collectives = COLLECTIVE_CALLABLES | extra
+        self.extra = extra
         self.cfg = CFG(fn)
-        self.taint = RankTaint(fn, shape_seeds=shape_seeds)
+        self.taint = RankTaint(fn, shape_seeds=shape_seeds,
+                               extra_collectives=extra)
         #: id(stmt) -> chain of (guard stmt, arm statements) from the
         #: outermost enclosing branch/loop inward
         self.guards: Dict[int, Tuple[Tuple[ast.stmt, List[ast.stmt]], ...]] \
@@ -70,7 +80,7 @@ class _FunctionAnalysis:
             for expr in stmt_exprs(node.stmt):
                 for sub in ast.walk(expr):
                     if isinstance(sub, ast.Call) and \
-                            call_name(sub) in COLLECTIVE_CALLABLES:
+                            call_name(sub) in self.all_collectives:
                         names.add(call_name(sub))
             if names:
                 self.node_collectives[node] = names
@@ -134,8 +144,7 @@ class _FunctionAnalysis:
                 out.append((r, guard, sorted(downstream)[0]))
         return out
 
-    @staticmethod
-    def _participates_before(arm: Sequence[ast.stmt],
+    def _participates_before(self, arm: Sequence[ast.stmt],
                              raise_stmt: ast.stmt) -> bool:
         """A collective call inside the guarded arm, textually before
         the raise, means this rank joins the barrier before failing
@@ -144,27 +153,39 @@ class _FunctionAnalysis:
         for stmt in arm:
             for node in ast.walk(stmt):
                 if isinstance(node, ast.Call) and \
-                        call_name(node) in COLLECTIVE_CALLABLES and \
+                        call_name(node) in self.all_collectives and \
                         node.lineno < r_line:
                     return True
         return False
 
 
-_CACHE: Dict[Tuple[str, int], _FunctionAnalysis] = {}
+_CACHE: Dict[Tuple[str, int, frozenset], _FunctionAnalysis] = {}
 
 
-def _analyses(parsed: ParsedFile) -> Iterator[_FunctionAnalysis]:
-    """One analysis per top function that contains a collective call."""
+def _extra_collectives(rule: Rule, parsed: ParsedFile) -> frozenset:
+    """Interprocedural collective spellings for this file, when the
+    analyzer attached callgraph facts to the rule."""
+    facts = getattr(rule, "facts", None)
+    if facts is None:
+        return frozenset()
+    return facts.collective_call_names(parsed.path)
+
+
+def _analyses(parsed: ParsedFile,
+              extra: frozenset = frozenset()
+              ) -> Iterator[_FunctionAnalysis]:
+    """One analysis per top function that contains a collective call
+    (base or interprocedurally-resolved)."""
     if parsed.tree is None:
         return
     shape_seeds = not parsed.in_device_dir()
     for fn in iter_top_functions(parsed.tree):
-        if not collective_calls(fn):
+        if not collective_calls(fn, extra):
             continue
-        key = (parsed.path, fn.lineno)
+        key = (parsed.path, fn.lineno, extra)
         fa = _CACHE.get(key)
         if fa is None or fa.fn is not fn:
-            fa = _FunctionAnalysis(fn, shape_seeds)
+            fa = _FunctionAnalysis(fn, shape_seeds, extra)
             _CACHE[key] = fa
         yield fa
 
@@ -181,7 +202,7 @@ class CollectiveBranchRule(Rule):
 
     def check(self, parsed: ParsedFile) -> List[Finding]:
         findings: List[Finding] = []
-        for fa in _analyses(parsed):
+        for fa in _analyses(parsed, _extra_collectives(self, parsed)):
             raise_guards = {id(g) for _, g, _ in fa.stranded_raises()}
             for node in fa.cfg.nodes:
                 stmt = node.stmt
@@ -201,14 +222,14 @@ class CollectiveBranchRule(Rule):
                             f"the other arm never enter the barrier"))
                 elif isinstance(stmt, (ast.While, ast.For)) and \
                         fa.taint.stmt_test_tainted(stmt):
-                    inner = {call_name(c) for c in collective_calls(stmt)
-                             if call_name(c) in COLLECTIVE_CALLABLES}
+                    inner = {call_name(c)
+                             for c in collective_calls(stmt, fa.extra)}
                     # names in the loop header don't iterate with the body
                     header = set()
                     for expr in stmt_exprs(stmt):
                         for sub in ast.walk(expr):
                             if isinstance(sub, ast.Call) and \
-                                    call_name(sub) in COLLECTIVE_CALLABLES:
+                                    call_name(sub) in fa.all_collectives:
                                 header.add(call_name(sub))
                     inner -= header
                     if inner:
@@ -224,9 +245,10 @@ class CollectiveBranchRule(Rule):
                 if not isinstance(node, ast.IfExp) or \
                         not fa.taint.expr_tainted(node.test):
                     continue
-                then_c = {call_name(c) for c in collective_calls(node.body)}
-                else_c = {call_name(c) for c in
-                          collective_calls(node.orelse)}
+                then_c = {call_name(c)
+                          for c in collective_calls(node.body, fa.extra)}
+                else_c = {call_name(c)
+                          for c in collective_calls(node.orelse, fa.extra)}
                 if then_c != else_c:
                     findings.append(self.finding(
                         parsed, node.lineno,
@@ -247,7 +269,7 @@ class CollectiveRaiseRule(Rule):
 
     def check(self, parsed: ParsedFile) -> List[Finding]:
         findings: List[Finding] = []
-        for fa in _analyses(parsed):
+        for fa in _analyses(parsed, _extra_collectives(self, parsed)):
             for r, guard, coll in fa.stranded_raises():
                 findings.append(self.finding(
                     parsed, r.lineno,
@@ -268,8 +290,8 @@ class CollectiveShapeRule(Rule):
 
     def check(self, parsed: ParsedFile) -> List[Finding]:
         findings: List[Finding] = []
-        for fa in _analyses(parsed):
-            for call in collective_calls(fa.fn):
+        for fa in _analyses(parsed, _extra_collectives(self, parsed)):
+            for call in collective_calls(fa.fn, fa.extra):
                 for arg in call.args:
                     if fa.taint.expr_shape_tainted(arg):
                         findings.append(self.finding(

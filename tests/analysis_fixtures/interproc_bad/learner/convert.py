@@ -1,0 +1,6 @@
+"""Fixture helper: host-syncs its parameter (callee side of the
+cross-module JIT003 case)."""
+
+
+def to_python_scalar(v):
+    return float(v)

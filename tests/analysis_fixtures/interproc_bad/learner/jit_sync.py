@@ -1,0 +1,13 @@
+"""Fixture: jitted body passes a traced value to a helper that
+host-syncs it in another module — invisible to the lexical JIT003,
+caught by the interprocedural engine."""
+
+import jax
+
+from .convert import to_python_scalar
+
+
+@jax.jit
+def scale(x):
+    s = to_python_scalar(x)
+    return x * s

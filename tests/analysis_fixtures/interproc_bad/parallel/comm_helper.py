@@ -1,0 +1,8 @@
+"""Fixture helper: performs a collective inside a callee (the
+collective-in-callee side of the cross-module COLL001 case)."""
+
+import jax
+
+
+def sync_error_count(err):
+    return jax.lax.psum(err, "ranks")

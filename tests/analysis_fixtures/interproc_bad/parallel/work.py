@@ -1,0 +1,13 @@
+"""Fixture: rank-divergent branch around a helper that psums inside —
+the lexical COLL001 sees no collective here; the call graph does."""
+
+import jax
+
+from .comm_helper import sync_error_count
+
+
+def report(err):
+    r = jax.lax.axis_index("ranks")
+    if r == 0:
+        return sync_error_count(err)
+    return err

@@ -1,0 +1,15 @@
+"""Fixture: calls a `_locked` helper from another module without
+holding any lock — the delegation edge only the call graph resolves."""
+
+import threading
+
+from .store import append_locked
+
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buf = []
+
+    def add(self, item):
+        append_locked(self._buf, item)

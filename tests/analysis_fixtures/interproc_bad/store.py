@@ -1,0 +1,6 @@
+"""Fixture helper: `_locked`-suffixed mutator — the suffix contract
+says every caller must hold the owning lock."""
+
+
+def append_locked(buf, item):
+    buf.append(item)

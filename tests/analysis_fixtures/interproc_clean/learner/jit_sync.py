@@ -1,0 +1,12 @@
+"""Clean twin: the jitted body calls a helper, but the helper only
+touches shape metadata — no host sync anywhere on the chain."""
+
+import jax
+
+from .convert import leading_dim
+
+
+@jax.jit
+def scale(x):
+    n = leading_dim(x)
+    return x * n

@@ -1,0 +1,8 @@
+"""Clean twin helper: same collective in a callee, reached from a
+rank-uniform caller."""
+
+import jax
+
+
+def sync_error_count(err):
+    return jax.lax.psum(err, "ranks")

@@ -1,0 +1,11 @@
+"""Clean twin: the collective-bearing helper runs unconditionally on
+every rank — no divergent control flow guards it."""
+
+import jax
+
+from .comm_helper import sync_error_count
+
+
+def report(err):
+    total = sync_error_count(err)
+    return jax.numpy.where(total > 0, total, err)

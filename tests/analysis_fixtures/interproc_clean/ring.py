@@ -1,0 +1,15 @@
+"""Clean twin: the `_locked` delegate is called with the lock held."""
+
+import threading
+
+from .store import append_locked
+
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buf = []
+
+    def add(self, item):
+        with self._lock:
+            append_locked(self._buf, item)

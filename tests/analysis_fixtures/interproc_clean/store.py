@@ -1,0 +1,6 @@
+"""Clean twin helper: identical `_locked` mutator; callers hold the
+lock."""
+
+
+def append_locked(buf, item):
+    buf.append(item)

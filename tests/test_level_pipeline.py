@@ -75,7 +75,6 @@ def test_compile_count_bounded_and_no_shape_leak():
     # be cold here so compiles are attributable (no other tier-1 file
     # touches grower_pipeline)
     args_a = _inputs(seed=1)
-    args_b = _inputs(seed=2)               # same shapes, new data
     kw = _KW
     plan = growth_plan(num_leaves=kw["num_leaves"])
     _obs.compiles.reset()
@@ -93,16 +92,32 @@ def test_compile_count_bounded_and_no_shape_leak():
     for entry, rec in snap.items():
         assert rec["compiles"] == 1, (entry, rec)
 
-    # shape-leak regression: identical shapes + config must be pure
-    # cache hits — a leaked weak type / python scalar in the stage
-    # signature would recompile here
-    grow_tree_pipelined(*args_b, lookahead=2, **kw)
-    snap2 = {k: v for k, v in _obs.compiles.snapshot().items()
-             if k.startswith("grow_stage_")}
-    assert len(snap2) == plan.n_stage_programs
-    for entry, rec in snap2.items():
-        assert rec["compiles"] == 1, (entry, rec)
-        assert rec["hits"] >= 1, (entry, rec)
+
+def test_fixup_program_retrace_stable():
+    # shape-leak guard, checked at the trace level instead of by
+    # re-dispatching the whole pipeline: the fixup stage's jaxpr must
+    # be identical across iteration indices — the retrace_stable
+    # helper the TRACE005 lint contract runs over the production
+    # manifest. If `it` (or any value derived from it) were baked into
+    # the program, each fixup dispatch would recompile and the compile
+    # bound above would be a lie. Traces only: nothing executes.
+    import functools
+
+    import jax
+
+    from lightgbm_tpu.analysis.tracecheck import retrace_stable
+    from lightgbm_tpu.learner import grower_pipeline as gp
+
+    names = ("bins", "grad", "hess", "cnt_weight", "feature_mask",
+             "num_bins", "missing_is_nan", "is_cat_feat")
+    base = dict(zip(names, _inputs(seed=1)))
+    state0, quant0 = jax.eval_shape(
+        functools.partial(gp._stage, stage="init", **_KW), **base)
+    argsets = [dict(base, stage="fixup", state=state0,
+                    quant_state=quant0,
+                    it=jnp.asarray(i, jnp.int32), **_KW)
+               for i in (3, 9)]
+    assert retrace_stable(gp._stage, argsets)
 
 
 # slow tier: the monolith oracle is a SECOND ~10s interpret-mode

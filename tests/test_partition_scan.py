@@ -26,6 +26,7 @@ pytestmark = pytest.mark.kernels
 import jax
 import jax.numpy as jnp
 
+from lightgbm_tpu.analysis.tracecheck import has_sort_primitive
 from lightgbm_tpu.learner.histogram_pallas import (_stable_order_scan,
                                                    partition_rows)
 
@@ -95,18 +96,6 @@ class TestAdversarialParity:
             assert np.asarray(x).tobytes() == np.asarray(y).tobytes()
 
 
-def _has_sort_primitive(jaxpr) -> bool:
-    for eqn in jaxpr.eqns:
-        if eqn.primitive.name == "sort":
-            return True
-        for v in eqn.params.values():
-            for sub in (v if isinstance(v, (list, tuple)) else (v,)):
-                if hasattr(sub, "jaxpr") and \
-                        _has_sort_primitive(sub.jaxpr):
-                    return True
-    return False
-
-
 @pytest.mark.perf
 class TestScanStructure:
     """Microbench-shaped assertions: the structural facts behind the
@@ -125,6 +114,10 @@ class TestScanStructure:
             assert x.tobytes() == y.tobytes()
 
     def test_scan_path_has_no_sort_primitive(self):
+        # shared predicate with TRACE001 (analysis.tracecheck): the
+        # same walk the lint-time contract checker runs over the
+        # manifest entry; the argsort oracle doubles as its positive
+        # control
         slot = jnp.asarray(np.random.RandomState(5).randint(0, 6, 2048),
                            jnp.int32)
 
@@ -136,8 +129,8 @@ class TestScanStructure:
             return partition_rows(s, num_slots=6, row_block=128,
                                   impl="argsort")
 
-        assert not _has_sort_primitive(jax.make_jaxpr(scan_part)(slot).jaxpr)
-        assert _has_sort_primitive(jax.make_jaxpr(argsort_part)(slot).jaxpr)
+        assert not has_sort_primitive(jax.make_jaxpr(scan_part)(slot))
+        assert has_sort_primitive(jax.make_jaxpr(argsort_part)(slot))
 
     def test_stable_rank_matches_argsort_rank(self):
         # _stable_order_scan directly vs the stable sort, with tail

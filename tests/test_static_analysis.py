@@ -29,6 +29,8 @@ ALL_RULE_IDS = (
     "DTYPE001", "DTYPE002", "FAULT001", "JIT001", "JIT002", "JIT003",
     "JIT004", "LOCK001", "LOCK002", "OBS001", "PALLAS001", "PERF001",
     "REG001", "REG002", "REG003", "REG004", "REG005", "SUP001",
+    "TRACE001", "TRACE002", "TRACE003", "TRACE004", "TRACE005",
+    "TRACE006",
 )
 
 
@@ -42,11 +44,18 @@ def hits(findings):
     return {(f.rule, f.line) for f in findings}
 
 
+# one full-package scan shared by every package-level assertion in
+# this file (the cold scan builds the trace reports; the wall-time
+# test below runs its own warm scan so the <10s budget is meaningful)
+@pytest.fixture(scope="module")
+def package_findings():
+    return Analyzer().run([PACKAGE_DIR])
+
+
 # ----------------------------------------------------------------------
 # the tier-1 gate: the package is clean
-def test_package_has_zero_unsuppressed_findings():
-    findings = Analyzer().run([PACKAGE_DIR])
-    active = [f for f in findings if not f.suppressed]
+def test_package_has_zero_unsuppressed_findings(package_findings):
+    active = [f for f in package_findings if not f.suppressed]
     assert not active, "tpulint violations:\n" + "\n".join(
         f.render() for f in active)
 
@@ -244,12 +253,11 @@ def test_collective_registry_discovery_fires():
     assert not any(f.rule == "SUP001" for f in findings)
 
 
-def test_collective_manifest_covered_in_package():
+def test_collective_manifest_covered_in_package(package_findings):
     # on the real package the manifest itself must be violation-free:
     # no COLL004 finding at all (covered entries + no unregistered
     # collective entry points)
-    findings = Analyzer().run([PACKAGE_DIR])
-    assert not [f for f in findings if f.rule == "COLL004"]
+    assert not [f for f in package_findings if f.rule == "COLL004"]
 
 
 def test_stale_suppression_self_check():
@@ -265,7 +273,121 @@ def test_stale_suppression_self_check():
             if f.rule == "LOCK001"} == {("LOCK001", 32, True)}
 
 
-def test_full_package_analysis_wall_time():
+# ----------------------------------------------------------------------
+# TRACE rule family: contracts checked on the traced program (jaxpr),
+# driven by a machine-checked manifest
+def test_trace_rules_fire():
+    findings = run_on("trace_bad")
+    assert hits(findings) == {
+        ("TRACE001", 94),   # sorting_entry: jnp.sort in the jaxpr
+        ("TRACE002", 97),   # f64_entry: strong float64 under x64
+        ("TRACE003", 100),  # callback_entry: debug_callback primitive
+        ("TRACE004", 103),  # dead_donation_entry: donation unusable
+        ("TRACE005", 107),  # baked_scalar_entry: static arg re-traces
+        ("TRACE006", 1),    # manifest-level coverage findings
+    }
+    cov = [f for f in findings if f.rule == "TRACE006"]
+    assert len(cov) == 2
+    msgs = " | ".join(f.message for f in cov)
+    assert "fused_dispatch" in msgs      # uncovered dispatch row
+    assert "old_entry" in msgs           # stale waiver
+
+
+def test_trace_clean_fixture_is_silent():
+    # donation consumed, traced scalar stable across retraces, x64
+    # trace clean, dispatch row covered, no waivers
+    assert run_on("trace_clean") == []
+
+
+def test_trace_manifest_covers_dispatch_sites():
+    # the production manifest must cover or explicitly waive every
+    # device-dispatch row — TRACE006 enforces this at lint time, this
+    # test pins it structurally so a new dispatch site fails fast
+    from lightgbm_tpu.analysis.rules_faults import DISPATCH_MANIFEST
+    from lightgbm_tpu.analysis.tracecheck import TRACE_MANIFEST, WAIVERS
+    covered = {c for e in TRACE_MANIFEST for c in e.covers}
+    for row in DISPATCH_MANIFEST:
+        key = tuple(row)
+        assert key in covered or key in WAIVERS, \
+            f"dispatch row {key} neither traced nor waived"
+    # waivers must carry a reason and not shadow a covered row
+    for key, reason in WAIVERS.items():
+        assert reason.strip()
+        assert key not in covered
+
+
+# ----------------------------------------------------------------------
+# interprocedural engine: findings that require the project call graph
+def test_interproc_findings_fire_across_modules():
+    findings = run_on("interproc_bad")
+    got = {(f.rule, os.path.basename(f.path), f.line) for f in findings}
+    assert got == {
+        ("JIT003", "jit_sync.py", 12),  # float() two modules away
+        ("COLL001", "work.py", 11),     # psum hidden inside the callee
+        ("LOCK001", "ring.py", 15),     # _locked delegate, no lock held
+    }
+    # findings must name the callee and its definition site
+    jit = next(f for f in findings if f.rule == "JIT003")
+    assert "to_python_scalar" in jit.message
+    assert "convert.py" in jit.message
+    lock = next(f for f in findings if f.rule == "LOCK001")
+    assert "append_locked" in lock.message
+    assert "store.py" in lock.message
+
+
+def test_interproc_findings_need_the_callgraph():
+    # the same fixtures are provably invisible to the intraprocedural
+    # engine: each file is clean in isolation
+    bad = os.path.join(FIXTURES, "interproc_bad")
+    assert Analyzer(interproc=False).run([bad]) == []
+
+
+def test_interproc_clean_fixture_is_silent():
+    # lock held around the delegate, shape-only helper, rank-uniform
+    # collective call — the call graph must not over-taint these
+    assert run_on("interproc_clean") == []
+
+
+# ----------------------------------------------------------------------
+# incremental cache: content-hash keys, dependent invalidation
+def test_lint_cache_roundtrip_and_invalidation(tmp_path):
+    from lightgbm_tpu.analysis.cache import LintCache
+    src = tmp_path / "mod.py"
+    dep = tmp_path / "helper.py"
+    src.write_text("x = 1\n")
+    dep.write_text("y = 2\n")
+
+    cache = LintCache(str(tmp_path))
+    key = cache.file_key(str(src), [str(dep)], interproc=True)
+    assert cache.get_file_findings(key) is None
+    cache.put_file_findings(key, [{"rule": "JIT003", "line": 3}])
+    # a fresh instance (no memoized hashes) computes the same key and
+    # reads the stored payload back
+    fresh = LintCache(str(tmp_path))
+    assert fresh.file_key(str(src), [str(dep)], interproc=True) == key
+    assert fresh.get_file_findings(key) == [{"rule": "JIT003",
+                                             "line": 3}]
+    # toggling interproc changes the key
+    assert cache.file_key(str(src), [str(dep)],
+                          interproc=False) != key
+    # editing only the *dependency* invalidates the dependent file
+    dep.write_text("y = 3\n")
+    assert LintCache(str(tmp_path)).file_key(
+        str(src), [str(dep)], interproc=True) != key
+
+
+def test_cache_engages_for_package_scans_only(package_findings):
+    # the shared package scan (the fixture) ran with cache on
+    from lightgbm_tpu.analysis.cache import CACHE_DIR_NAME
+    repo_root = os.path.dirname(PACKAGE_DIR)
+    assert os.path.isdir(os.path.join(repo_root, CACHE_DIR_NAME))
+    # fixture scans must never sprinkle cache directories around
+    assert not os.path.exists(os.path.join(FIXTURES, CACHE_DIR_NAME))
+
+
+def test_full_package_analysis_wall_time(package_findings):
+    # warm-cache scan (the shared module fixture paid the cold trace
+    # builds): the per-commit lint loop must stay under the budget
     import time
     t0 = time.monotonic()
     Analyzer().run([PACKAGE_DIR])
@@ -282,7 +404,8 @@ def _run_cli(*args):
 
 
 def test_cli_exit_codes_and_json():
-    bad = _run_cli(os.path.join(FIXTURES, "lock_bad.py"),
+    # --no-cache rides along: accepted, and findings are unchanged
+    bad = _run_cli(os.path.join(FIXTURES, "lock_bad.py"), "--no-cache",
                    "--format=json")
     assert bad.returncode == 1
     payload = json.loads(bad.stdout)
@@ -329,3 +452,13 @@ def test_cli_list_rules():
     assert res.returncode == 0
     for rule_id in ALL_RULE_IDS:
         assert rule_id in res.stdout
+
+
+def test_cli_no_interproc_flag():
+    # the default-on behaviour is pinned in-process
+    # (test_interproc_findings_fire_across_modules); here the flag must
+    # drop the cross-module findings through the CLI
+    off = _run_cli(os.path.join(FIXTURES, "interproc_bad"),
+                   "--no-interproc", "--format=json")
+    assert off.returncode == 0
+    assert json.loads(off.stdout)["unsuppressed"] == 0
